@@ -1,0 +1,9 @@
+"""Host-side reference cryptography.
+
+Pure-Python implementations used for signing (cold path — one signature per
+block per validator, cf. reference consensus/state.go:2522), key generation,
+and as the differential-test oracle for the TPU kernels in
+``tendermint_tpu.ops``. The hot path (batch verification) lives on-device.
+"""
+
+from tendermint_tpu.crypto import ed25519  # noqa: F401

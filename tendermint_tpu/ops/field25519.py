@@ -1,0 +1,246 @@
+"""GF(2^255-19) arithmetic for TPU: batched, radix-2^8 limbs, int32 lanes.
+
+Design notes (TPU-first, not a port — the reference uses x/crypto's 64-bit
+assembly field ops, crypto/ed25519/ed25519.go:148-162 in /root/reference):
+
+- A field element is ``[..., 32] int32``: 32 little-endian limbs of 8 bits.
+  Radix 2^8 is chosen so that (a) encoded byte strings ARE the limb vector,
+  (b) limb products fit comfortably in int32 (no 64-bit multiplies — TPUs
+  have no native int64), and (c) a future Pallas kernel can feed the limbs
+  to the MXU as int8 operands with int32 accumulation.
+- "Loose" invariant: every public op accepts and returns limbs in [0, 2^9).
+  Products then satisfy: conv term < 2^18, 32-term column sum < 2^23, and
+  after the fold by 38 (2^256 ≡ 38 mod p) columns stay < 39*2^23 < 2^28.3,
+  inside int32.
+- Carries are vectorized shift-add passes (4 passes restore the loose
+  invariant after a multiply — see bound chain in `_carry_pass`); the exact
+  sequential carry (lax.scan over the 32 limbs) is reserved for
+  canonicalization, which only happens at batch boundaries.
+- No data-dependent control flow: everything is select/mask based, so the
+  whole verifier jits to one XLA program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+NLIMBS = 32
+P = 2**255 - 19
+
+# canonical limbs of p: [237, 255 x30, 127]
+P_LIMBS = np.array(
+    [int(b) for b in P.to_bytes(32, "little")], dtype=np.int32
+)
+# 8p = 2^258 - 152 decomposed non-canonically as [872, 1020 x31]:
+#   872 + 1020 * (2^256 - 2^8)/255 = 2^258 - 152.
+# Used as the additive bias in `sub` so limb-wise differences stay
+# non-negative for any loose (< 2^9 ≤ 1020/2) subtrahend.
+_BIAS_8P = np.full(NLIMBS, 1020, dtype=np.int32)
+_BIAS_8P[0] = 872
+assert sum(int(v) << (8 * i) for i, v in enumerate(_BIAS_8P)) % P == 0
+
+
+def from_int(x: int) -> np.ndarray:
+    """Host helper: Python int -> limb vector (numpy, canonical)."""
+    return np.array(
+        [int(b) for b in (x % P).to_bytes(32, "little")], dtype=np.int32
+    )
+
+
+def to_int(limbs) -> int:
+    """Host helper: limb vector -> Python int (no reduction)."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return int(sum(int(v) << (8 * i) for i, v in enumerate(arr.tolist())))
+
+
+def zeros(shape=()) -> jnp.ndarray:
+    return jnp.zeros((*shape, NLIMBS), dtype=jnp.int32)
+
+
+def ones(shape=()) -> jnp.ndarray:
+    z = np.zeros((*shape, NLIMBS), dtype=np.int32)
+    z[..., 0] = 1
+    return jnp.asarray(z)
+
+
+def constant(x: int, shape=()) -> jnp.ndarray:
+    """Broadcast a Python-int field constant to [..., 32] limbs."""
+    base = from_int(x)
+    return jnp.broadcast_to(jnp.asarray(base), (*shape, NLIMBS))
+
+
+def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """One vectorized carry pass with the mod-p wrap (2^256 ≡ 38).
+
+    Bound chain after `mul`'s fold (columns < 2^28.3):
+      pass1: limbs < 2^20.4 (limb0 < 2^25.6)
+      pass2: limbs < 2^17.7
+      pass3: limbs < 2^10.3
+      pass4: limbs < 294 < 2^9   -> loose invariant restored.
+    """
+    c = x >> 8
+    r = x - (c << 8)
+    wrap = jnp.concatenate([c[..., 31:] * 38, c[..., :31]], axis=-1)
+    return r + wrap
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b; loose in, loose out (sum < 2^10, one pass -> < 370)."""
+    return _carry_pass(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b via the 8p bias; loose in, loose out (< 446 after one pass)."""
+    return _carry_pass(a + jnp.asarray(_BIAS_8P) - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(jnp.asarray(_BIAS_8P) - a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook 32x32 limb convolution + fold by 38 + 4 carry passes.
+
+    The convolution is expressed as 32 shifted multiply-adds so XLA sees a
+    static unrolled pattern of [..., 32] vector ops (VPU-friendly; the
+    Pallas/MXU int8 variant keeps the same schedule).
+    """
+    out = jnp.zeros((*jnp.broadcast_shapes(a.shape, b.shape)[:-1], 63),
+                    dtype=jnp.int32)
+    for i in range(NLIMBS):
+        out = out.at[..., i : i + NLIMBS].add(a[..., i : i + 1] * b)
+    lo = out[..., :NLIMBS]
+    hi = out[..., NLIMBS:]
+    folded = lo.at[..., :31].add(hi * 38)
+    x = folded
+    for _ in range(4):
+        x = _carry_pass(x)
+    return x
+
+
+def sqr(x: jnp.ndarray) -> jnp.ndarray:
+    return mul(x, x)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a * k for small non-negative int k (k < 2^21 keeps products safe)."""
+    x = a * k
+    x = _carry_pass(x)
+    x = _carry_pass(x)
+    return _carry_pass(x)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """cond ? a : b, limb-wise; cond is [...] bool broadcast over limbs."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def _sqr_n(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """x^(2^n) via lax.fori_loop (keeps the traced graph small)."""
+    return jax.lax.fori_loop(0, n, lambda _, v: mul(v, v), x)
+
+
+def _pow_2_250_minus_1(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(2^250 - 1) — shared prefix of the inversion/sqrt chains (ref10)."""
+    z2 = sqr(z)
+    z9 = mul(sqr(sqr(z2)), z)
+    z11 = mul(z9, z2)
+    z2_5_0 = mul(sqr(z11), z9)  # z^(2^5-1)
+    z2_10_0 = mul(_sqr_n(z2_5_0, 5), z2_5_0)
+    z2_20_0 = mul(_sqr_n(z2_10_0, 10), z2_10_0)
+    z2_40_0 = mul(_sqr_n(z2_20_0, 20), z2_20_0)
+    z2_50_0 = mul(_sqr_n(z2_40_0, 10), z2_10_0)
+    z2_100_0 = mul(_sqr_n(z2_50_0, 50), z2_50_0)
+    z2_200_0 = mul(_sqr_n(z2_100_0, 100), z2_100_0)
+    z2_250_0 = mul(_sqr_n(z2_200_0, 50), z2_50_0)
+    return z2_250_0, z11
+
+
+def invert(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(p-2) = z^(2^255 - 21). Returns 0 for z = 0."""
+    z2_250_0, z11 = _pow_2_250_minus_1(z)
+    return mul(_sqr_n(z2_250_0, 5), z11)
+
+
+def pow22523(z: jnp.ndarray) -> jnp.ndarray:
+    """z^((p-5)/8) = z^(2^252 - 3), used by sqrt-ratio in decompression."""
+    z2_250_0, _ = _pow_2_250_minus_1(z)
+    return mul(_sqr_n(z2_250_0, 2), z)
+
+
+def _scan_carry(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact sequential carry over the limb axis (no wrap).
+
+    Returns (strict limbs in [0, 255], top carry = value >> 256).
+    Works for signed inputs too (borrows propagate as negative carries).
+    """
+    xt = jnp.moveaxis(x, -1, 0)  # [32, ...]
+
+    def step(carry, limb):
+        v = limb + carry
+        c = v >> 8
+        return c, v - (c << 8)
+
+    top, limbs = jax.lax.scan(step, jnp.zeros_like(xt[0]), xt)
+    return jnp.moveaxis(limbs, 0, -1), top
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Freeze a loose element to its canonical limbs in [0, p).
+
+    Only used at batch boundaries (encoding, equality); costs a few
+    lax.scan passes over the 32 limbs.
+    """
+    # 1. exact carry; fold top carry K (V = K*2^256 + V0 ≡ V0 + 38K).
+    limbs, top = _scan_carry(x)
+    limbs = limbs.at[..., 0].add(top * 38)
+    limbs, top = _scan_carry(limbs)  # top == 0 now (V0 + 38K < 2^256 + 114)
+    limbs = limbs.at[..., 0].add(top * 38)
+    # 2. fold bit 255: V = q*2^255 + W ≡ W + 19q.
+    q = limbs[..., 31] >> 7
+    limbs = limbs.at[..., 31].add(-(q << 7))
+    limbs = limbs.at[..., 0].add(q * 19)
+    limbs, _ = _scan_carry(limbs)
+    q = limbs[..., 31] >> 7
+    limbs = limbs.at[..., 31].add(-(q << 7))
+    limbs = limbs.at[..., 0].add(q * 19)  # cannot ripple: W < 134 here if q=1
+    # 3. now V < 2^255; subtract p once if V >= p.
+    p_l = jnp.asarray(P_LIMBS)
+    diff = limbs - p_l
+    # most-significant nonzero difference decides >=
+    nz = diff != 0
+    # index of the highest nonzero limb (0 if none)
+    idx = (NLIMBS - 1) - jnp.argmax(nz[..., ::-1], axis=-1)
+    ms = jnp.take_along_axis(diff, idx[..., None], axis=-1)[..., 0]
+    any_nz = jnp.any(nz, axis=-1)
+    geq = jnp.where(any_nz, ms > 0, True)  # equal -> subtract to get 0
+    limbs = limbs - p_l * geq[..., None].astype(jnp.int32)
+    limbs, _ = _scan_carry(limbs)
+    return limbs
+
+
+def to_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    """Canonical little-endian 32-byte encoding as [..., 32] uint8."""
+    return canonical(x).astype(jnp.uint8)
+
+
+def from_bytes(b: jnp.ndarray) -> jnp.ndarray:
+    """[..., 32] uint8 little-endian bytes -> loose limbs (identity map)."""
+    return b.astype(jnp.int32)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Canonical equality: [...] bool."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def parity(x: jnp.ndarray) -> jnp.ndarray:
+    """Low bit of the canonical value (the ed25519 sign bit source)."""
+    return canonical(x)[..., 0] & 1

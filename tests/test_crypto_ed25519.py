@@ -1,0 +1,81 @@
+"""Host reference ed25519 against RFC 8032 §7.1 test vectors."""
+
+import hashlib
+
+from tendermint_tpu.crypto import ed25519
+
+# (seed, pubkey, msg, sig) hex — RFC 8032 §7.1 TEST 1-3
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e065224901555fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+def test_rfc8032_vectors():
+    for seed_hex, pk_hex, msg_hex, sig_hex in RFC8032_VECTORS:
+        sk = ed25519.PrivKey(bytes.fromhex(seed_hex))
+        pk = sk.public_key()
+        msg = bytes.fromhex(msg_hex)
+        assert pk.data.hex() == pk_hex
+        sig = sk.sign(msg)
+        assert sig.hex() == sig_hex
+        assert pk.verify(msg, sig)
+
+
+def test_sign_verify_roundtrip_and_tamper():
+    sk = ed25519.PrivKey.from_secret(b"validator-0")
+    pk = sk.public_key()
+    msg = b"canonical vote sign bytes"
+    sig = sk.sign(msg)
+    assert pk.verify(msg, sig)
+    assert not pk.verify(msg + b"x", sig)
+    assert not pk.verify(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    other = ed25519.PrivKey.from_secret(b"validator-1").public_key()
+    assert not other.verify(msg, sig)
+
+
+def test_reject_high_s():
+    sk = ed25519.PrivKey.from_secret(b"v")
+    pk = sk.public_key()
+    msg = b"m"
+    sig = sk.sign(msg)
+    s = int.from_bytes(sig[32:], "little")
+    # s + L is the classic malleability twin; must be rejected.
+    bad = sig[:32] + int.to_bytes(s + ed25519.L, 32, "little")
+    assert not pk.verify(msg, bad)
+
+
+def test_reject_bad_pubkey_encoding():
+    sk = ed25519.PrivKey.from_secret(b"v")
+    msg = b"m"
+    sig = sk.sign(msg)
+    # y = p (non-canonical encoding of 0) must be rejected.
+    bad_pk = int.to_bytes(ed25519.P, 32, "little")
+    assert not ed25519.verify(bad_pk, msg, sig)
+    # a y with no corresponding x
+    y = 2
+    while ed25519._recover_x(y, 0) is not None:
+        y += 1
+    assert not ed25519.verify(int.to_bytes(y, 32, "little"), msg, sig)
+
+
+def test_address():
+    pk = ed25519.PrivKey.from_secret(b"v").public_key()
+    assert pk.address() == hashlib.sha256(pk.data).digest()[:20]
+    assert len(pk.address()) == 20

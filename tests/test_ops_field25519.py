@@ -1,0 +1,146 @@
+"""Differential tests: JAX GF(2^255-19) limb arithmetic vs Python bigints."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import field25519 as fe
+
+P = fe.P
+rng = np.random.default_rng(1234)
+
+
+def rand_ints(n):
+    vals = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n)]
+    # include edge cases
+    vals[:6] = [0, 1, P - 1, P - 19, 2**255 - 20, (1 << 255) - 1 - 19]
+    return [v % P for v in vals]
+
+
+def pack(vals):
+    return jnp.asarray(np.stack([fe.from_int(v) for v in vals]))
+
+
+def unpack_canonical(limbs):
+    arr = np.asarray(limbs)
+    return [fe.to_int(row) for row in arr]
+
+
+N = 16
+A_INTS = rand_ints(N)
+B_INTS = rand_ints(N)[::-1]
+A = pack(A_INTS)
+B = pack(B_INTS)
+
+
+def assert_loose(x):
+    arr = np.asarray(x)
+    assert arr.min() >= 0 and arr.max() < 512, (arr.min(), arr.max())
+
+
+def test_roundtrip():
+    assert unpack_canonical(fe.canonical(A)) == [a % P for a in A_INTS]
+
+
+def test_add():
+    out = fe.add(A, B)
+    assert_loose(out)
+    assert unpack_canonical(fe.canonical(out)) == [
+        (a + b) % P for a, b in zip(A_INTS, B_INTS)
+    ]
+
+
+def test_sub():
+    out = fe.sub(A, B)
+    assert_loose(out)
+    assert unpack_canonical(fe.canonical(out)) == [
+        (a - b) % P for a, b in zip(A_INTS, B_INTS)
+    ]
+
+
+def test_neg():
+    out = fe.neg(A)
+    assert_loose(out)
+    assert unpack_canonical(fe.canonical(out)) == [(-a) % P for a in A_INTS]
+
+
+def test_mul():
+    out = fe.mul(A, B)
+    assert_loose(out)
+    assert unpack_canonical(fe.canonical(out)) == [
+        (a * b) % P for a, b in zip(A_INTS, B_INTS)
+    ]
+
+
+def test_mul_loose_inputs():
+    # worst-case loose inputs: all limbs 511
+    x = jnp.full((4, 32), 511, dtype=jnp.int32)
+    xv = fe.to_int(np.full(32, 511, dtype=np.int64)) % P
+    out = fe.mul(x, x)
+    assert_loose(out)
+    assert unpack_canonical(fe.canonical(out)) == [(xv * xv) % P] * 4
+
+
+def test_sqr_chain():
+    # repeated squaring keeps the invariant and matches bigint
+    x = A
+    ref = list(A_INTS)
+    for _ in range(8):
+        x = fe.sqr(x)
+        ref = [(v * v) % P for v in ref]
+        assert_loose(x)
+    assert unpack_canonical(fe.canonical(x)) == ref
+
+
+def test_mul_small():
+    out = fe.mul_small(A, 121666)
+    assert_loose(out)
+    assert unpack_canonical(fe.canonical(out)) == [
+        (a * 121666) % P for a in A_INTS
+    ]
+
+
+def test_invert():
+    out = fe.invert(A)
+    got = unpack_canonical(fe.canonical(out))
+    for a, g in zip(A_INTS, got):
+        if a == 0:
+            assert g == 0
+        else:
+            assert g == pow(a, P - 2, P)
+
+
+def test_pow22523():
+    out = fe.pow22523(A)
+    got = unpack_canonical(fe.canonical(out))
+    for a, g in zip(A_INTS, got):
+        assert g == pow(a, (P - 5) // 8, P)
+
+
+@pytest.mark.parametrize(
+    "v",
+    [0, 1, 19, P - 1, P, P + 1, 2 * P - 1, 2 * P, 2**255 - 1, 2**256 - 1],
+)
+def test_canonical_edge_values(v):
+    # feed raw (possibly >= p, >= 2^255) limb encodings of v
+    limbs = np.array(
+        [int(b) for b in (v % 2**256).to_bytes(32, "little")], dtype=np.int32
+    )
+    out = fe.canonical(jnp.asarray(limbs)[None])
+    assert unpack_canonical(out) == [(v % 2**256) % P]
+
+
+def test_eq_and_parity():
+    assert bool(np.asarray(fe.eq(A, A)).all())
+    assert not bool(np.asarray(fe.eq(A, B)).any())
+    par = np.asarray(fe.parity(A))
+    assert par.tolist() == [a % 2 for a in A_INTS]
+
+
+def test_select():
+    cond = jnp.asarray([True, False] * (N // 2))
+    out = fe.select(cond, A, B)
+    got = unpack_canonical(fe.canonical(out))
+    want = [a if i % 2 == 0 else b for i, (a, b) in enumerate(zip(A_INTS, B_INTS))]
+    assert got == [w % P for w in want]
