@@ -1,0 +1,66 @@
+"""Benchmark: batched ed25519 verification throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference verifies votes serially via Go x/crypto ed25519 —
+~50-70 µs/verify single-core (SURVEY.md §6; crypto/ed25519/bench_test.go is
+the reference harness, no stored numbers), i.e. ~15,000 sigs/s. The
+BASELINE.json north-star targets >50k sigs/s/chip. vs_baseline is measured
+sigs/s divided by the 15k serial-CPU figure.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_SERIAL_SIGS_PER_S = 15_000.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _make_batch
+    from tendermint_tpu.ops.ed25519_batch import verify_prehashed
+
+    fn = jax.jit(verify_prehashed)
+
+    batch = 2048
+    pub, rb, sb, kb, s_ok = _make_batch(min(batch, 256))
+    # tile the signed rows up to the full batch (unique rows are host-bound
+    # to generate; verification cost on device is identical either way)
+    reps = (batch + pub.shape[0] - 1) // pub.shape[0]
+
+    def tile(x):
+        return jnp.asarray(np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:batch])
+
+    args = (tile(pub), tile(rb), tile(sb), tile(kb), tile(s_ok))
+
+    out = np.asarray(fn(*args))  # compile + warm
+    assert out.all(), "benchmark batch failed to verify"
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    sigs_per_s = batch / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_throughput",
+                "value": round(sigs_per_s, 1),
+                "unit": "sigs/s/chip",
+                "vs_baseline": round(sigs_per_s / BASELINE_SERIAL_SIGS_PER_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""Host utility runtime (the framework's answer to the reference's libs/).
+
+Reference: libs/ — 25 subpackages, ~9k LoC of Go (SURVEY.md layer 0). Here
+the host framework is asyncio Python, so several reference packages map to
+the stdlib (clist→deque, cmap→dict, async→asyncio, timer→loop.call_later)
+and the rest live in this package: protoio (varint wire), bits (BitArray),
+service (lifecycle), events (sync event switch), pubsub (queryable server),
+log (structured), fail (crash-point injection), autofile (rotating file
+groups backing the WAL).
+"""

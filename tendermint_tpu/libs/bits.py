@@ -1,0 +1,108 @@
+"""BitArray — vote-presence bitmaps for gossip.
+
+Reference: libs/bits (444 LoC, `bits.BitArray`), used by the consensus
+reactor's per-peer bookkeeping (consensus/reactor.go PeerState) and
+VoteSetBits messages. Backed by a Python int (arbitrary-precision bitmask)
+instead of []uint64 — simpler and fast enough on the host plane; the device
+plane uses numpy bool arrays and converts at the edge.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BitArray:
+    size: int
+    _bits: int = 0
+
+    @classmethod
+    def from_indices(cls, size: int, indices) -> "BitArray":
+        ba = cls(size)
+        for i in indices:
+            ba.set(i, True)
+        return ba
+
+    @classmethod
+    def from_bools(cls, bools) -> "BitArray":
+        ba = cls(len(bools))
+        for i, v in enumerate(bools):
+            ba.set(i, bool(v))
+        return ba
+
+    def get(self, i: int) -> bool:
+        if not 0 <= i < self.size:
+            return False
+        return bool((self._bits >> i) & 1)
+
+    def set(self, i: int, v: bool) -> bool:
+        if not 0 <= i < self.size:
+            return False
+        if v:
+            self._bits |= 1 << i
+        else:
+            self._bits &= ~(1 << i)
+        return True
+
+    def _mask(self) -> int:
+        return (1 << self.size) - 1
+
+    def copy(self) -> "BitArray":
+        return BitArray(self.size, self._bits)
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        size = max(self.size, other.size)
+        return BitArray(size, self._bits | other._bits)
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        size = min(self.size, other.size)
+        return BitArray(size, self._bits & other._bits & ((1 << size) - 1))
+
+    def not_(self) -> "BitArray":
+        return BitArray(self.size, ~self._bits & self._mask())
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        """Bits set in self but not in other (reference `Sub`)."""
+        return BitArray(self.size, self._bits & ~other._bits & self._mask())
+
+    def is_empty(self) -> bool:
+        return self._bits == 0
+
+    def is_full(self) -> bool:
+        return self.size > 0 and self._bits == self._mask()
+
+    def pick_random(self) -> tuple[int, bool]:
+        """A uniformly random set bit (reference PickRandom) — used by vote
+        gossip to choose which missing vote to send."""
+        ones = [i for i in range(self.size) if self.get(i)]
+        if not ones:
+            return 0, False
+        return ones[secrets.randbelow(len(ones))], True
+
+    def ones(self) -> list[int]:
+        return [i for i in range(self.size) if self.get(i)]
+
+    def num_set(self) -> int:
+        return bin(self._bits & self._mask()).count("1")
+
+    def to_bytes(self) -> bytes:
+        nbytes = (self.size + 7) // 8
+        return self._bits.to_bytes(nbytes, "little")
+
+    @classmethod
+    def from_bytes(cls, size: int, data: bytes) -> "BitArray":
+        ba = cls(size)
+        ba._bits = int.from_bytes(data, "little") & ba._mask()
+        return ba
+
+    def __str__(self) -> str:
+        return "".join("x" if self.get(i) else "_" for i in range(self.size))
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, BitArray)
+            and self.size == other.size
+            and self._bits == other._bits
+        )

@@ -1,0 +1,246 @@
+"""Batched edwards25519 group arithmetic on TPU (JAX, limb vectors).
+
+Role (SURVEY.md §2.2 row "ed25519 verify"): the reference verifies votes one
+at a time through golang.org/x/crypto ed25519 (crypto/ed25519/ed25519.go:148-162
+in /root/reference). Here the whole group layer is data-parallel: a point is a
+``[..., 4, 32] int32`` array (X, Y, Z, T extended homogeneous coordinates, each
+a radix-2^8 field element from ``ops.field25519``), and every operation maps
+over arbitrary leading batch axes. No data-dependent control flow: failures
+(bad decompression, wrong sign) come back as boolean masks, so a batch of
+signatures is one straight-line XLA program that `vmap`/`shard_map` can tile
+across a TPU mesh.
+
+Formula choices (tpu-first):
+- unified add: add-2008-hwcd-3 for a=-1 (complete — identity/doubling safe,
+  so table entries need no special-casing),
+- dedicated double: ref10 shape, 4S+4M,
+- fixed-base scalar mult: 64x16 precomputed radix-16 table of the basepoint
+  (no doublings at all — 63 batched gathers+adds),
+- variable-base scalar mult: per-element 16-entry window table (14 adds) +
+  256 doublings + 64 gather-adds, MSB-first.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import field25519 as fe
+from ..crypto import ed25519 as host
+
+NLIMBS = fe.NLIMBS
+
+# 2*d mod p as a field constant (edwards d from the host reference impl).
+_D = host.D
+_D2 = (2 * host.D) % host.P
+_SQRT_M1 = host.SQRT_M1
+
+
+def _const(x: int) -> jnp.ndarray:
+    return jnp.asarray(fe.from_int(x))
+
+
+# --- representation -------------------------------------------------------
+
+
+def identity(shape=()) -> jnp.ndarray:
+    """The neutral element (0, 1, 1, 0) broadcast to [*shape, 4, 32]."""
+    z = np.zeros((*shape, 4, NLIMBS), dtype=np.int32)
+    z[..., 1, 0] = 1  # Y = 1
+    z[..., 2, 0] = 1  # Z = 1
+    return jnp.asarray(z)
+
+
+def from_host_point(p: host.Point) -> np.ndarray:
+    """Host helper: python-int extended point -> [4, 32] limbs."""
+    return np.stack([fe.from_int(c) for c in p])
+
+
+def neg(p: jnp.ndarray) -> jnp.ndarray:
+    """-(X, Y, Z, T) = (-X, Y, Z, -T)."""
+    x, y, z, t = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    return jnp.stack([fe.neg(x), y, z, fe.neg(t)], axis=-2)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """cond ? a : b with cond of shape [...] broadcast over (4, 32)."""
+    return jnp.where(cond[..., None, None], a, b)
+
+
+# --- group law ------------------------------------------------------------
+
+
+def add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Complete unified addition (add-2008-hwcd-3, a=-1)."""
+    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    x2, y2, z2, t2 = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+    a = fe.mul(fe.sub(y1, x1), fe.sub(y2, x2))
+    b = fe.mul(fe.add(y1, x1), fe.add(y2, x2))
+    c = fe.mul(fe.mul(t1, t2), jnp.asarray(fe.from_int(_D2)))
+    d = fe.mul_small(fe.mul(z1, z2), 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return jnp.stack(
+        [fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h)], axis=-2
+    )
+
+
+def double(p: jnp.ndarray) -> jnp.ndarray:
+    """Dedicated doubling (ref10 ge_p2_dbl shape), 4S+4M."""
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    xx = fe.sqr(x1)
+    yy = fe.sqr(y1)
+    b = fe.mul_small(fe.sqr(z1), 2)
+    aa = fe.sqr(fe.add(x1, y1))
+    y3 = fe.add(yy, xx)  # YY + XX
+    z3 = fe.sub(yy, xx)  # YY - XX
+    x3 = fe.sub(aa, y3)  # 2XY
+    t3 = fe.sub(b, z3)  # 2ZZ - (YY - XX)
+    return jnp.stack(
+        [fe.mul(x3, t3), fe.mul(y3, z3), fe.mul(z3, t3), fe.mul(x3, y3)],
+        axis=-2,
+    )
+
+
+# --- encoding -------------------------------------------------------------
+
+
+def compress(p: jnp.ndarray) -> jnp.ndarray:
+    """Canonical 32-byte encoding: y with the sign(x) bit on top. [..., 32] u8."""
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    zinv = fe.invert(z)
+    xa = fe.canonical(fe.mul(x, zinv))
+    ya = fe.canonical(fe.mul(y, zinv))
+    sign = xa[..., 0] & 1
+    ya = ya.at[..., 31].add(sign << 7)
+    return ya.astype(jnp.uint8)
+
+
+def decompress(b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched point decompression.
+
+    b: [..., 32] uint8. Returns (point [..., 4, 32], valid [...] bool).
+    Rejects (mask False): y >= p (non-canonical), x^2 with no square root,
+    x = 0 with sign bit set. Mirrors the host oracle `_recover_x`
+    (crypto/ed25519 semantics of the reference, crypto/ed25519/ed25519.go).
+    """
+    b = b.astype(jnp.int32)
+    sign = b[..., 31] >> 7
+    y = b.at[..., 31].add(-(sign << 7))  # clear bit 255
+    # canonical check: y < p (limb-wise compare against P, big-endian scan)
+    p_l = jnp.asarray(fe.P_LIMBS)
+    diff = y - p_l
+    nz = diff != 0
+    idx = (NLIMBS - 1) - jnp.argmax(nz[..., ::-1], axis=-1)
+    ms = jnp.take_along_axis(diff, idx[..., None], axis=-1)[..., 0]
+    y_lt_p = jnp.where(jnp.any(nz, axis=-1), ms < 0, False)
+
+    yy = fe.sqr(y)
+    u = fe.sub(yy, fe.ones(y.shape[:-1]))  # y^2 - 1
+    v = fe.add(fe.mul(yy, _const(_D)), fe.ones(y.shape[:-1]))  # d y^2 + 1
+    # x = u v^3 (u v^7)^((p-5)/8)  — one exponentiation, then fixups.
+    v3 = fe.mul(fe.sqr(v), v)
+    v7 = fe.mul(fe.sqr(v3), v)
+    x = fe.mul(fe.mul(u, v3), fe.pow22523(fe.mul(u, v7)))
+    vx2 = fe.mul(v, fe.sqr(x))
+    ok_direct = fe.eq(vx2, u)
+    ok_flipped = fe.eq(vx2, fe.neg(u))
+    x = fe.select(ok_flipped, fe.mul(x, _const(_SQRT_M1)), x)
+    has_root = ok_direct | ok_flipped
+
+    x_is_zero = fe.is_zero(x)
+    sign_ok = ~(x_is_zero & (sign == 1))
+    # conditional negate to match the sign bit
+    x = fe.select((fe.parity(x) != sign) & ~x_is_zero, fe.neg(x), x)
+
+    valid = y_lt_p & has_root & sign_ok
+    pt = jnp.stack([x, y, fe.ones(y.shape[:-1]), fe.mul(x, y)], axis=-2)
+    return pt, valid
+
+
+# --- scalars --------------------------------------------------------------
+
+
+def nibbles(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
+    """[..., 32] u8 little-endian scalar -> [..., 64] int32 radix-16 digits
+    (least-significant first)."""
+    s = scalar_bytes.astype(jnp.int32)
+    lo = s & 15
+    hi = s >> 4
+    return jnp.stack([lo, hi], axis=-1).reshape(*s.shape[:-1], 64)
+
+
+# --- fixed-base table (basepoint) -----------------------------------------
+
+_BASE_TABLE_NP: np.ndarray | None = None
+
+
+def _base_table() -> np.ndarray:
+    """T[i, j] = [j * 16^i]B as [64, 16, 4, 32] int32, built on host once."""
+    global _BASE_TABLE_NP
+    if _BASE_TABLE_NP is None:
+        rows = []
+        row = [host.IDENTITY]
+        for j in range(1, 16):
+            row.append(host.point_add(row[-1], host.BASEPOINT))
+        for _ in range(64):
+            rows.append([from_host_point(p) for p in row])
+            row = [
+                host.point_double(
+                    host.point_double(host.point_double(host.point_double(p)))
+                )
+                for p in row
+            ]
+        _BASE_TABLE_NP = np.asarray(rows, dtype=np.int32)
+    return _BASE_TABLE_NP
+
+
+def scalar_mult_base(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
+    """[s]B for s: [..., 32] u8 (little-endian, < 2^256). No doublings:
+    sum over 64 radix-16 digit rows of the precomputed basepoint table."""
+    digs = nibbles(scalar_bytes)  # [..., 64] LSB-first
+    table = jnp.asarray(_base_table())  # [64, 16, 4, 32]
+
+    def body(i, acc):
+        row = jax.lax.dynamic_index_in_dim(table, i, keepdims=False)
+        entry = jnp.take(row, digs[..., i], axis=0)  # [..., 4, 32]
+        return add(acc, entry)
+
+    return jax.lax.fori_loop(0, 64, body, identity(digs.shape[:-1]))
+
+
+def scalar_mult_var(scalar_bytes: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """[s]P batched variable-base: per-element radix-16 window table.
+
+    scalar_bytes: [..., 32] u8; p: [..., 4, 32]. 14 adds for the table,
+    then 64 iterations of (4 doublings + gather + add), MSB-first.
+    """
+    digs = nibbles(scalar_bytes)  # [..., 64]
+    batch_shape = digs.shape[:-1]
+
+    # window table [..., 16, 4, 32]: 0, P, 2P, ..., 15P
+    entries = [identity(batch_shape), p]
+    for _ in range(14):
+        entries.append(add(entries[-1], p))
+    table = jnp.stack(entries, axis=-3)
+
+    def body(i, acc):
+        acc = double(double(double(double(acc))))
+        dig = digs[..., 63 - i]  # MSB-first
+        entry = jnp.take_along_axis(
+            table, dig[..., None, None, None], axis=-3
+        ).squeeze(-3)
+        return add(acc, entry)
+
+    return jax.lax.fori_loop(0, 64, body, identity(batch_shape))
+
+
+def double_scalar_mult_base(
+    s_bytes: jnp.ndarray, k_bytes: jnp.ndarray, a: jnp.ndarray
+) -> jnp.ndarray:
+    """[s]B + [k]A — the ed25519 verification combination."""
+    return add(scalar_mult_base(s_bytes), scalar_mult_var(k_bytes, a))

@@ -1,0 +1,44 @@
+"""Batched ed25519 verification kernel (JAX → XLA → TPU).
+
+The TPU replacement for the reference's serial per-vote loop
+(types/vote_set.go:205 → crypto/ed25519/ed25519.go:148-162 in
+/root/reference): one straight-line program that verifies B signatures at
+once and returns an accept bitmap. No early exit, no branches — rejects are
+masks, which is the TPU-friendly replacement for the reference's
+``return false`` paths.
+
+The kernel takes *prehashed* challenges: k = SHA-512(R || A || M) mod L is
+computed by the caller (host today, on-device sha512 kernel as it lands —
+ops/sha512.py) because the per-vote message is ragged while everything in
+here is fixed-shape. The s < L range check is likewise a host-computed input
+mask (`s_ok`): s is attacker-controlled bytes and the check is a trivial
+256-bit compare.
+
+Verification equation (cofactorless, matching Go x/crypto semantics):
+    [s]B == R + [k]A   ⇔   encode([s]B + [k](-A)) == R_bytes
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import curve25519 as curve
+
+
+def verify_prehashed(
+    pubkeys: jnp.ndarray,  # [B, 32] uint8
+    r_bytes: jnp.ndarray,  # [B, 32] uint8 (first half of each signature)
+    s_bytes: jnp.ndarray,  # [B, 32] uint8 (second half; caller checks < L)
+    k_bytes: jnp.ndarray,  # [B, 32] uint8 (SHA-512(R||A||M) mod L)
+    s_ok: jnp.ndarray,  # [B] bool (host-side s < L check)
+) -> jnp.ndarray:
+    """Returns [B] bool accept bitmap."""
+    a_point, a_valid = curve.decompress(pubkeys)
+    q = curve.double_scalar_mult_base(s_bytes, k_bytes, curve.neg(a_point))
+    encoded = curve.compress(q)
+    r_match = jnp.all(encoded == r_bytes, axis=-1)
+    return a_valid & s_ok & r_match
+
+
+verify_prehashed_jit = jax.jit(verify_prehashed)

@@ -126,7 +126,13 @@ def sqr(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """a * k for small non-negative int k (k < 2^21 keeps products safe)."""
+    """a * k for small non-negative int k.
+
+    Bound: loose input (< 2^9) * k must survive three carry passes back to
+    the loose invariant, which holds for k <= 2^17 (products < 2^26, well
+    inside int32; pass chain verified numerically at the worst case).
+    """
+    assert 0 <= k <= 1 << 17, "mul_small constant out of verified range"
     x = a * k
     x = _carry_pass(x)
     x = _carry_pass(x)
@@ -143,7 +149,7 @@ def _sqr_n(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return jax.lax.fori_loop(0, n, lambda _, v: mul(v, v), x)
 
 
-def _pow_2_250_minus_1(z: jnp.ndarray) -> jnp.ndarray:
+def _pow_2_250_minus_1(z: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """z^(2^250 - 1) — shared prefix of the inversion/sqrt chains (ref10)."""
     z2 = sqr(z)
     z9 = mul(sqr(sqr(z2)), z)

@@ -1,0 +1,168 @@
+"""Unit tests for the host utility runtime (libs layer)."""
+
+import asyncio
+import os
+from io import BytesIO
+
+import pytest
+
+from tendermint_tpu.libs import autofile, bits, protoio, pubsub
+from tendermint_tpu.libs.events import EventSwitch
+from tendermint_tpu.libs.service import Service
+
+
+# --- protoio --------------------------------------------------------------
+
+
+def test_uvarint_roundtrip():
+    for n in [0, 1, 127, 128, 300, 2**32, 2**63 - 1]:
+        buf = BytesIO(protoio.write_uvarint(n))
+        assert protoio.read_uvarint(buf) == n
+
+
+def test_delimited_roundtrip():
+    payload = b"canonical vote bytes"
+    framed = protoio.marshal_delimited(payload)
+    assert protoio.read_delimited(BytesIO(framed)) == payload
+
+
+def test_field_encoding_roundtrip():
+    msg = (
+        protoio.field_varint(1, 42)
+        + protoio.field_bytes(2, b"hash")
+        + protoio.field_sfixed64(3, -7)
+        + protoio.field_varint(4, 0)  # zero omitted
+    )
+    fields = protoio.decode_fields(msg)
+    assert fields[1] == [42]
+    assert fields[2] == [b"hash"]
+    assert fields[3] == [-7]
+    assert 4 not in fields
+
+
+def test_negative_varint_is_64bit_twos_complement():
+    data = protoio.write_varint(-1)
+    assert protoio.read_uvarint(BytesIO(data)) == 2**64 - 1
+
+
+# --- bits -----------------------------------------------------------------
+
+
+def test_bitarray_ops():
+    a = bits.BitArray.from_indices(10, [1, 3, 5])
+    b = bits.BitArray.from_indices(10, [3, 4])
+    assert a.get(3) and not a.get(2)
+    assert a.or_(b).ones() == [1, 3, 4, 5]
+    assert a.and_(b).ones() == [3]
+    assert a.sub(b).ones() == [1, 5]
+    assert a.not_().ones() == [0, 2, 4, 6, 7, 8, 9]
+    assert a.num_set() == 3
+    rt = bits.BitArray.from_bytes(10, a.to_bytes())
+    assert rt == a
+    idx, ok = a.pick_random()
+    assert ok and idx in (1, 3, 5)
+    assert not bits.BitArray(4).pick_random()[1]
+
+
+def test_bitarray_out_of_range():
+    a = bits.BitArray(4)
+    assert not a.set(4, True)
+    assert not a.get(-1)
+
+
+# --- pubsub query ---------------------------------------------------------
+
+
+def test_query_matching():
+    q = pubsub.Query("tm.event = 'NewBlock' AND block.height > 5")
+    assert q.matches({"tm.event": ["NewBlock"], "block.height": ["6"]})
+    assert not q.matches({"tm.event": ["NewBlock"], "block.height": ["5"]})
+    assert not q.matches({"tm.event": ["Tx"], "block.height": ["6"]})
+    assert not q.matches({"tm.event": ["NewBlock"]})
+
+
+def test_query_exists_contains():
+    q = pubsub.Query("account.owner EXISTS AND tx.hash CONTAINS 'abc'")
+    assert q.matches({"account.owner": ["x"], "tx.hash": ["zzabczz"]})
+    assert not q.matches({"tx.hash": ["zzabczz"]})
+
+
+def test_pubsub_publish_subscribe():
+    async def run():
+        srv = pubsub.PubSubServer()
+        sub = srv.subscribe("client1", pubsub.Query("tm.event = 'Tx'"))
+        await srv.publish("blk", {"tm.event": ["NewBlock"]})
+        await srv.publish("tx1", {"tm.event": ["Tx"]})
+        msg = await asyncio.wait_for(sub.next(), 1)
+        assert msg.data == "tx1"
+        srv.unsubscribe_all("client1")
+        with pytest.raises(pubsub.SubscriptionCancelled):
+            await sub.next()
+
+    asyncio.run(run())
+
+
+# --- events ---------------------------------------------------------------
+
+
+def test_event_switch():
+    sw = EventSwitch()
+    got = []
+    sw.add_listener("l1", "step", got.append)
+    sw.fire_event("step", 1)
+    sw.remove_listener("l1")
+    sw.fire_event("step", 2)
+    assert got == [1]
+
+
+# --- service --------------------------------------------------------------
+
+
+def test_service_lifecycle():
+    async def run():
+        class S(Service):
+            started = stopped = False
+
+            async def on_start(self):
+                self.started = True
+
+            async def on_stop(self):
+                self.stopped = True
+
+        s = S("test")
+        await s.start()
+        assert s.is_running and s.started
+        with pytest.raises(RuntimeError):
+            await s.start()
+        await s.stop()
+        assert s.stopped and not s.is_running
+        await s.wait_stopped()
+
+    asyncio.run(run())
+
+
+# --- autofile -------------------------------------------------------------
+
+
+def test_autofile_rotation(tmp_path):
+    head = str(tmp_path / "wal")
+    g = autofile.Group(head, head_size_limit=100)
+    for i in range(30):
+        g.write(b"x" * 10)
+        g.check_head_size_limit()
+    g.sync()
+    assert g.max_index() >= 0  # rotated at least once
+    data = g.read_all()
+    assert data == b"x" * 300
+    g.close()
+
+
+def test_autofile_total_size_prune(tmp_path):
+    head = str(tmp_path / "wal")
+    g = autofile.Group(head, head_size_limit=50, total_size_limit=120)
+    for _ in range(40):
+        g.write(b"y" * 10)
+        g.check_head_size_limit()
+    total = len(g.read_all())
+    assert total <= 170  # oldest chunks pruned
+    g.close()

@@ -1,0 +1,113 @@
+"""Differential tests: batched TPU curve ops vs the pure-python host oracle."""
+
+import hashlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as host
+from tendermint_tpu.ops import curve25519 as curve
+from tendermint_tpu.ops import field25519 as fe
+
+# jit everything once — eager dispatch of these deep graphs is pathologically
+# slow on the CPU test platform, and jit also exercises the real path.
+_add = jax.jit(curve.add)
+_double = jax.jit(curve.double)
+_compress = jax.jit(curve.compress)
+_decompress = jax.jit(curve.decompress)
+_smul_base = jax.jit(curve.scalar_mult_base)
+_smul_var = jax.jit(curve.scalar_mult_var)
+
+
+def _rand_points(n, seed=0):
+    """n pseudorandom curve points (as host points) via hashing to scalars."""
+    pts = []
+    for i in range(n):
+        s = int.from_bytes(hashlib.sha512(bytes([seed, i])).digest(), "little")
+        pts.append(host.scalar_mult(s % host.L, host.BASEPOINT))
+    return pts
+
+
+def _to_batch(pts):
+    return jnp.asarray(np.stack([curve.from_host_point(p) for p in pts]))
+
+
+def _assert_points_equal(dev_pts, host_pts):
+    enc = np.asarray(_compress(dev_pts))
+    for i, hp in enumerate(host_pts):
+        assert bytes(enc[i].tobytes()) == host.point_compress(hp), f"idx {i}"
+
+
+def test_add_double_match_host():
+    ps = _rand_points(4, seed=1)
+    qs = _rand_points(4, seed=2)
+    dev_sum = _add(_to_batch(ps), _to_batch(qs))
+    _assert_points_equal(dev_sum, [host.point_add(p, q) for p, q in zip(ps, qs)])
+    dev_dbl = _double(_to_batch(ps))
+    _assert_points_equal(dev_dbl, [host.point_add(p, p) for p in ps])
+
+
+def test_add_identity_and_self():
+    ps = _rand_points(2, seed=3)
+    batch = _to_batch(ps)
+    _assert_points_equal(_add(batch, curve.identity((2,))), ps)
+    # unified add must handle P+P (completeness)
+    _assert_points_equal(_add(batch, batch), [host.point_add(p, p) for p in ps])
+
+
+def test_compress_decompress_roundtrip():
+    ps = _rand_points(4, seed=4)
+    enc = np.stack(
+        [np.frombuffer(host.point_compress(p), dtype=np.uint8) for p in ps]
+    )
+    pt, valid = _decompress(jnp.asarray(enc))
+    assert np.asarray(valid).all()
+    _assert_points_equal(pt, ps)
+
+
+def test_decompress_rejects_bad_encodings():
+    bad = np.zeros((3, 32), dtype=np.uint8)
+    # y = p (non-canonical encoding of 0)
+    bad[0] = np.frombuffer(host.P.to_bytes(32, "little"), dtype=np.uint8)
+    # y = 2 is not on the curve (x^2 = (y^2-1)/(dy^2+1) is non-square for y=2)
+    bad[1, 0] = 2
+    # x=0 point (y=1) with sign bit set
+    bad[2, 0] = 1
+    bad[2, 31] = 0x80
+    _, valid = _decompress(jnp.asarray(bad))
+    valid = np.asarray(valid)
+    assert not valid[0]
+    assert not valid[2]
+    # row 1: mirror the host oracle
+    assert valid[1] == (host.point_decompress(bytes(bad[1].tobytes())) is not None)
+
+
+def test_scalar_mult_base_matches_host():
+    scalars = [0, 1, 2, host.L - 1, 2**256 - 1]
+    sb = np.stack(
+        [
+            np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8)
+            for s in scalars
+        ]
+    )
+    out = _smul_base(jnp.asarray(sb))
+    _assert_points_equal(
+        out, [host.scalar_mult(s, host.BASEPOINT) for s in scalars]
+    )
+
+
+def test_scalar_mult_var_matches_host():
+    pts = _rand_points(3, seed=5)
+    scalars = [7, host.L - 2, 2**255 + 12345]
+    sb = np.stack(
+        [
+            np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8)
+            for s in scalars
+        ]
+    )
+    out = _smul_var(jnp.asarray(sb), _to_batch(pts))
+    _assert_points_equal(
+        out, [host.scalar_mult(s, p) for s, p in zip(scalars, pts)]
+    )

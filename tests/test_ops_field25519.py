@@ -14,7 +14,7 @@ rng = np.random.default_rng(1234)
 def rand_ints(n):
     vals = [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n)]
     # include edge cases
-    vals[:6] = [0, 1, P - 1, P - 19, 2**255 - 20, (1 << 255) - 1 - 19]
+    vals[:6] = [0, 1, 2, P - 1, P - 19, P // 2]
     return [v % P for v in vals]
 
 
