@@ -21,9 +21,7 @@ Responsibilities:
 
 from __future__ import annotations
 
-import hashlib
 from dataclasses import dataclass
-from functools import partial
 
 import numpy as np
 
@@ -32,8 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import ed25519_batch
-
-L = 2**252 + 27742317777372353535851937790883648493
+from .ed25519 import L, challenge
 
 # Bucket sizes: small buckets for consensus latency (votes trickle in),
 # large for blocksync/light-client bulk replay.
@@ -41,12 +38,14 @@ BUCKETS = (8, 32, 128, 512, 2048, 8192)
 
 
 def _bucket(n: int, multiple_of: int = 1) -> int:
-    for b in BUCKETS:
-        if b >= n and b % multiple_of == 0:
-            return b
-    # round up to a multiple of the largest bucket
-    q = BUCKETS[-1]
-    return ((n + q - 1) // q) * q
+    """Smallest padded size >= n from BUCKETS, rounded up so the batch axis
+    divides evenly across `multiple_of` mesh shards."""
+    base = next((b for b in BUCKETS if b >= n), None)
+    if base is None:
+        q = BUCKETS[-1]
+        base = ((n + q - 1) // q) * q
+    m = multiple_of
+    return ((base + m - 1) // m) * m
 
 
 @dataclass(frozen=True)
@@ -96,12 +95,7 @@ class BatchVerifier:
                 continue  # leave row zeroed; s_ok stays False -> reject
             r, s = it.sig[:32], it.sig[32:]
             s_int = int.from_bytes(s, "little")
-            k = (
-                int.from_bytes(
-                    hashlib.sha512(r + it.pubkey + it.msg).digest(), "little"
-                )
-                % L
-            )
+            k = challenge(r, it.pubkey, it.msg)
             pub[i] = np.frombuffer(it.pubkey, dtype=np.uint8)
             rb[i] = np.frombuffer(r, dtype=np.uint8)
             sb[i] = np.frombuffer(s, dtype=np.uint8)

@@ -197,6 +197,16 @@ class PubKey:
         return verify(self.data, msg, sig)
 
 
+def challenge(r_bytes: bytes, pubkey: bytes, msg: bytes) -> int:
+    """k = SHA-512(R || A || M) mod L — the verification challenge scalar.
+    Shared by the host oracle and the TPU batch pipeline (which hashes on
+    host until the device SHA-512 kernel takes over)."""
+    return (
+        int.from_bytes(hashlib.sha512(r_bytes + pubkey + msg).digest(), "little")
+        % L
+    )
+
+
 def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
     """Single-signature verification; the oracle for the TPU batch kernel."""
     if len(pubkey) != 32 or len(sig) != 64:
@@ -208,7 +218,7 @@ def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
     s = int.from_bytes(ss, "little")
     if s >= L:  # malleability check, per RFC 8032 §5.1.7 / Go x/crypto
         return False
-    k = int.from_bytes(hashlib.sha512(Rs + pubkey + msg).digest(), "little") % L
+    k = challenge(Rs, pubkey, msg)
     # [s]B + [k](-A) must encode to exactly the R bytes.
     Q = point_add(scalar_mult(s, BASEPOINT), scalar_mult(k, point_neg(A)))
     return point_compress(Q) == Rs

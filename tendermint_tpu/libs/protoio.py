@@ -124,9 +124,15 @@ def iter_fields(data: bytes):
                 raise EOFError("truncated bytes field")
             yield fnum, wt, chunk
         elif wt == WIRE_FIXED64:
-            yield fnum, wt, struct.unpack("<q", buf.read(8))[0]
+            chunk = buf.read(8)
+            if len(chunk) != 8:
+                raise EOFError("truncated fixed64 field")
+            yield fnum, wt, struct.unpack("<q", chunk)[0]  # sfixed64 signed
         elif wt == WIRE_FIXED32:
-            yield fnum, wt, struct.unpack("<i", buf.read(4))[0]
+            chunk = buf.read(4)
+            if len(chunk) != 4:
+                raise EOFError("truncated fixed32 field")
+            yield fnum, wt, struct.unpack("<I", chunk)[0]
         else:
             raise ValueError(f"unsupported wire type {wt}")
 

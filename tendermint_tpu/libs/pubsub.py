@@ -31,6 +31,25 @@ class Condition:
     value: Any = None
 
 
+def _split_and(s: str) -> list[str]:
+    """Split on ' AND ' outside single-quoted values."""
+    parts, buf, in_quote = [], [], False
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "'":
+            in_quote = not in_quote
+        if not in_quote and s.startswith(" AND ", i):
+            parts.append("".join(buf))
+            buf = []
+            i += 5
+            continue
+        buf.append(c)
+        i += 1
+    parts.append("".join(buf))
+    return parts
+
+
 class Query:
     """AND-composed conditions over event tag maps (libs/pubsub/query)."""
 
@@ -38,7 +57,7 @@ class Query:
         self.query_str = query_str.strip()
         self.conditions: list[Condition] = []
         if self.query_str:
-            for part in self.query_str.split(" AND "):
+            for part in _split_and(self.query_str):
                 m = _COND_RE.fullmatch(part)
                 if not m:
                     raise ValueError(f"invalid query condition: {part!r}")
@@ -155,16 +174,25 @@ class PubSubServer:
         self._subs[key] = sub
         return sub
 
+    @staticmethod
+    def _deliver_cancel(sub: Subscription, reason: str) -> None:
+        """Guarantee the cancellation sentinel lands even on a full queue."""
+        try:
+            sub.queue.put_nowait(_Cancelled(reason))
+        except asyncio.QueueFull:
+            sub.queue.get_nowait()  # drop oldest to make room
+            sub.queue.put_nowait(_Cancelled(reason))
+
     def unsubscribe(self, subscriber: str, query: Query) -> None:
         key = (subscriber, query.query_str)
         sub = self._subs.pop(key, None)
         if sub is None:
             raise KeyError("subscription not found")
-        sub.queue.put_nowait(_Cancelled("unsubscribed"))
+        self._deliver_cancel(sub, "unsubscribed")
 
     def unsubscribe_all(self, subscriber: str) -> None:
         for key in [k for k in self._subs if k[0] == subscriber]:
-            self._subs.pop(key).queue.put_nowait(_Cancelled("unsubscribed"))
+            self._deliver_cancel(self._subs.pop(key), "unsubscribed")
 
     def num_clients(self) -> int:
         return len({k[0] for k in self._subs})
@@ -183,4 +211,4 @@ class PubSubServer:
                     self._subs.pop(key, None)
                     while not sub.queue.empty():
                         sub.queue.get_nowait()
-                    sub.queue.put_nowait(_Cancelled("out of capacity"))
+                    self._deliver_cancel(sub, "out of capacity")

@@ -1,0 +1,28 @@
+"""Core consensus types (SURVEY.md layer 3, reference types/ ~7.1k LoC)."""
+
+from .canonical import (  # noqa: F401
+    CanonicalVoteEncoder,
+    proposal_sign_bytes,
+    vote_sign_bytes,
+)
+from .block import (  # noqa: F401
+    Block,
+    Commit,
+    CommitSig,
+    Data,
+    Header,
+    BlockIDFlag,
+    L2BatchHeader,
+    L2BlockMeta,
+)
+from .block_id import BlockID  # noqa: F401
+from .evidence import (  # noqa: F401
+    DuplicateVoteEvidence,
+    LightClientAttackEvidence,
+)
+from .part_set import Part, PartSet, PartSetHeader  # noqa: F401
+from .proposal import Proposal  # noqa: F401
+from .validator import Validator  # noqa: F401
+from .validator_set import ValidatorSet  # noqa: F401
+from .vote import Vote, VoteType  # noqa: F401
+from .vote_set import VoteSet  # noqa: F401

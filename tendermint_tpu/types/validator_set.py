@@ -1,0 +1,397 @@
+"""ValidatorSet — membership, proposer rotation, and commit verification.
+
+Reference: types/validator_set.go. Two things matter here:
+
+1. **Proposer priority arithmetic** (validator_set.go:105-246): the
+   deterministic weighted-round-robin. Reproduced exactly (rescale to the
+   2×total window, center on zero, add voting power, pick max, subtract
+   total) because every node must agree on the proposer.
+
+2. **Commit verification** (VerifyCommit :676, VerifyCommitLight :730,
+   VerifyCommitLightTrusting :782) — the reference's serial per-signer
+   ed25519 loops with 2/3 early exit. Here each becomes ONE TPU batch:
+   gather (pubkey, sign-bytes, sig) for every counted signer, verify all at
+   once, tally voting power under the accept mask (SURVEY.md §2.3: "full-
+   batch verify + masked power tally"). Semantics note: the reference
+   fails on the first invalid signature it happens to scan before reaching
+   2/3; the masked tally simply never counts invalid signatures, so any
+   commit carrying ≥2/3 of valid power verifies — never weaker, order-
+   independent, and branch-free on device. VerifyCommit (the full variant)
+   still requires every non-absent signature to be valid, as upstream does.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..crypto import merkle
+from ..crypto.batch_verifier import BatchVerifier, SigItem, default_verifier
+from ..libs import protoio as pio
+from .block import BlockIDFlag, Commit
+from .block_id import BlockID
+from .validator import Validator, pubkey_from_type, pubkey_type_name
+
+PRIORITY_WINDOW_SIZE_FACTOR = 2
+MAX_TOTAL_VOTING_POWER = 2**63 // 8
+
+
+class ValidatorSet:
+    def __init__(self, validators: list[Validator]):
+        self.validators: list[Validator] = sorted(
+            [v.copy() for v in validators], key=lambda v: v.address
+        )
+        self.proposer: Optional[Validator] = None
+        self._total_voting_power: Optional[int] = None
+        if self.validators:
+            self._validate_unique()
+            self.increment_proposer_priority(1)
+
+    @classmethod
+    def empty(cls) -> "ValidatorSet":
+        return cls([])
+
+    def _validate_unique(self) -> None:
+        seen = set()
+        for v in self.validators:
+            v.validate_basic()
+            if v.address in seen:
+                raise ValueError(f"duplicate validator {v.address.hex()}")
+            seen.add(v.address)
+
+    # --- basic queries ----------------------------------------------------
+
+    def size(self) -> int:
+        return len(self.validators)
+
+    def is_nil_or_empty(self) -> bool:
+        return not self.validators
+
+    def total_voting_power(self) -> int:
+        if self._total_voting_power is None:
+            t = sum(v.voting_power for v in self.validators)
+            if t > MAX_TOTAL_VOTING_POWER:
+                raise ValueError("total voting power exceeds maximum")
+            self._total_voting_power = t
+        return self._total_voting_power
+
+    def get_by_address(self, addr: bytes) -> tuple[int, Optional[Validator]]:
+        for i, v in enumerate(self.validators):
+            if v.address == addr:
+                return i, v
+        return -1, None
+
+    def get_by_index(self, idx: int) -> Optional[Validator]:
+        if 0 <= idx < len(self.validators):
+            return self.validators[idx]
+        return None
+
+    def has_address(self, addr: bytes) -> bool:
+        return self.get_by_address(addr)[0] >= 0
+
+    def hash(self) -> bytes:
+        """Merkle root of validator encodings
+        (reference types/validator_set.go:351)."""
+        return merkle.hash_from_byte_slices(
+            [v.encode() for v in self.validators]
+        )
+
+    def copy(self) -> "ValidatorSet":
+        vs = ValidatorSet.__new__(ValidatorSet)
+        vs.validators = [v.copy() for v in self.validators]
+        if self.proposer is not None:
+            i, _ = self.get_by_address(self.proposer.address)
+            vs.proposer = vs.validators[i] if i >= 0 else self.proposer.copy()
+        else:
+            vs.proposer = None
+        vs._total_voting_power = self._total_voting_power
+        return vs
+
+    # --- proposer priority (validator_set.go:105-246) ---------------------
+
+    def increment_proposer_priority(self, times: int) -> None:
+        if not self.validators:
+            raise ValueError("empty validator set")
+        if times <= 0:
+            raise ValueError("times must be positive")
+        diff_max = PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
+        self._rescale_priorities(diff_max)
+        self._shift_by_avg_proposer_priority()
+        proposer = None
+        for _ in range(times):
+            proposer = self._increment_proposer_priority_once()
+        self.proposer = proposer
+
+    def _increment_proposer_priority_once(self) -> Validator:
+        for v in self.validators:
+            v.proposer_priority += v.voting_power
+        mostest = self.validators[0]
+        for v in self.validators[1:]:
+            mostest = mostest.compare_proposer_priority(v)
+        mostest.proposer_priority -= self.total_voting_power()
+        return mostest
+
+    def _rescale_priorities(self, diff_max: int) -> None:
+        if diff_max <= 0 or not self.validators:
+            return
+        prios = [v.proposer_priority for v in self.validators]
+        diff = max(prios) - min(prios)
+        if diff > diff_max:
+            ratio = (diff + diff_max - 1) // diff_max
+            for v in self.validators:
+                # Go integer division truncates toward zero
+                q = abs(v.proposer_priority) // ratio
+                v.proposer_priority = q if v.proposer_priority >= 0 else -q
+
+    def _shift_by_avg_proposer_priority(self) -> None:
+        n = len(self.validators)
+        total = sum(v.proposer_priority for v in self.validators)
+        avg = abs(total) // n
+        avg = avg if total >= 0 else -avg  # truncate toward zero
+        for v in self.validators:
+            v.proposer_priority -= avg
+
+    def get_proposer(self) -> Validator:
+        if not self.validators:
+            raise ValueError("empty validator set")
+        if self.proposer is None:
+            mostest = self.validators[0]
+            for v in self.validators[1:]:
+                mostest = mostest.compare_proposer_priority(v)
+            self.proposer = mostest
+        return self.proposer
+
+    def copy_increment_proposer_priority(self, times: int) -> "ValidatorSet":
+        c = self.copy()
+        c.increment_proposer_priority(times)
+        return c
+
+    # --- updates (validator_set.go UpdateWithChangeSet) -------------------
+
+    def update_with_change_set(self, changes: list[Validator]) -> None:
+        if not changes:
+            return
+        by_addr = {}
+        for c in changes:
+            if c.voting_power < 0:
+                raise ValueError("voting power cannot be negative")
+            if c.address in by_addr:
+                raise ValueError("duplicate address in changes")
+            by_addr[c.address] = c
+
+        removals = {a for a, c in by_addr.items() if c.voting_power == 0}
+        for a in removals:
+            if not self.has_address(a):
+                raise ValueError("removing unknown validator")
+
+        updated: dict[bytes, Validator] = {
+            v.address: v for v in self.validators
+        }
+        # compute the new total first: new members join with priority
+        # -1.125 * new_total (validator_set.go computeNewPriorities)
+        tentative = dict(updated)
+        for a, c in by_addr.items():
+            if a in removals:
+                tentative.pop(a, None)
+            else:
+                tentative[a] = c
+        new_total = sum(v.voting_power for v in tentative.values())
+        if new_total > MAX_TOTAL_VOTING_POWER:
+            raise ValueError("total voting power exceeds maximum")
+
+        for a, c in by_addr.items():
+            if a in removals:
+                updated.pop(a, None)
+                continue
+            prev = updated.get(a)
+            nv = c.copy()
+            if prev is None:
+                nv.proposer_priority = -(new_total + (new_total >> 3))
+            else:
+                nv.proposer_priority = prev.proposer_priority
+            updated[a] = nv
+
+        self.validators = sorted(updated.values(), key=lambda v: v.address)
+        self._total_voting_power = None
+        if self.validators:
+            self._rescale_priorities(
+                PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
+            )
+            self._shift_by_avg_proposer_priority()
+            # recompute proposer pointer into the new list
+            if self.proposer is not None:
+                i, v = self.get_by_address(self.proposer.address)
+                self.proposer = v if i >= 0 else None
+
+    # --- commit verification (the TPU batch path) -------------------------
+
+    def _gather_items(
+        self,
+        chain_id: str,
+        commit: Commit,
+        only_for_block: bool,
+    ) -> tuple[list[SigItem], list[int]]:
+        """(items, indices): one SigItem per counted commit signature."""
+        items, idxs = [], []
+        for i, cs in enumerate(commit.signatures):
+            if cs.is_absent():
+                continue
+            if only_for_block and not cs.for_block():
+                continue
+            val = self.validators[i]
+            items.append(
+                SigItem(
+                    val.pub_key.data,
+                    commit.vote_sign_bytes(chain_id, i),
+                    cs.signature,
+                )
+            )
+            idxs.append(i)
+        return items, idxs
+
+    def verify_commit(
+        self,
+        chain_id: str,
+        block_id: BlockID,
+        height: int,
+        commit: Commit,
+        verifier: Optional[BatchVerifier] = None,
+    ) -> None:
+        """Full verification (reference :676): every non-absent signature
+        must be valid AND >2/3 of total power must have signed the block."""
+        self._check_commit_shape(block_id, height, commit)
+        verifier = verifier or default_verifier()
+        items, idxs = self._gather_items(chain_id, commit, False)
+        ok = verifier.verify(items)
+        tallied = 0
+        for valid, i in zip(ok, idxs):
+            if not valid:
+                raise ValueError(f"wrong signature at index {i}")
+            if commit.signatures[i].for_block():
+                tallied += self.validators[i].voting_power
+        self._check_maj23(tallied)
+
+    def verify_commit_light(
+        self,
+        chain_id: str,
+        block_id: BlockID,
+        height: int,
+        commit: Commit,
+        verifier: Optional[BatchVerifier] = None,
+    ) -> None:
+        """Light verification (reference :730, the blocksync/light-client
+        hot path): only ForBlock signatures counted; masked tally replaces
+        the serial 2/3 early exit."""
+        self._check_commit_shape(block_id, height, commit)
+        verifier = verifier or default_verifier()
+        items, idxs = self._gather_items(chain_id, commit, True)
+        ok = verifier.verify(items)
+        tallied = sum(
+            self.validators[i].voting_power
+            for valid, i in zip(ok, idxs)
+            if valid
+        )
+        self._check_maj23(tallied)
+
+    def verify_commit_light_trusting(
+        self,
+        chain_id: str,
+        commit: Commit,
+        trust_numerator: int = 1,
+        trust_denominator: int = 3,
+        verifier: Optional[BatchVerifier] = None,
+    ) -> None:
+        """Trusted-overlap verification (reference :782): this (old,
+        trusted) set need only overlap the commit by > trust-level of its
+        own power. Signers are matched by address, not index."""
+        if trust_denominator == 0:
+            raise ValueError("trust level has zero denominator")
+        verifier = verifier or default_verifier()
+        items, powers = [], []
+        seen: set[bytes] = set()
+        for i, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                continue
+            idx, val = self.get_by_address(cs.validator_address)
+            if idx < 0 or val is None:
+                continue
+            if val.address in seen:
+                raise ValueError("double vote from validator")
+            seen.add(val.address)
+            items.append(
+                SigItem(
+                    val.pub_key.data,
+                    commit.vote_sign_bytes(chain_id, i),
+                    cs.signature,
+                )
+            )
+            powers.append(val.voting_power)
+        ok = verifier.verify(items)
+        tallied = sum(p for valid, p in zip(ok, powers) if valid)
+        needed = (
+            self.total_voting_power() * trust_numerator
+        ) // trust_denominator
+        if tallied <= needed:
+            raise ValueError(
+                f"insufficient trusted voting power: {tallied} <= {needed}"
+            )
+
+    def _check_commit_shape(
+        self, block_id: BlockID, height: int, commit: Commit
+    ) -> None:
+        if self.size() != commit.size():
+            raise ValueError(
+                f"commit size {commit.size()} != valset size {self.size()}"
+            )
+        if height != commit.height:
+            raise ValueError("commit height mismatch")
+        if block_id != commit.block_id:
+            raise ValueError("commit block id mismatch")
+
+    def _check_maj23(self, tallied: int) -> None:
+        needed = self.total_voting_power() * 2 // 3
+        if tallied <= needed:
+            raise ValueError(
+                f"insufficient voting power: {tallied} <= {needed}"
+            )
+
+    # --- encoding ---------------------------------------------------------
+
+    def encode(self) -> bytes:
+        body = b"".join(
+            pio.field_message(
+                1,
+                v.encode() + pio.field_varint(4, v.proposer_priority + 2**62),
+            )
+            for v in self.validators
+        )
+        if self.proposer is not None:
+            body += pio.field_bytes(2, self.proposer.address)
+        return body
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ValidatorSet":
+        f = pio.decode_fields(data)
+        vals = []
+        for vd in f.get(1, []):
+            vf = pio.decode_fields(vd)
+            pk = pubkey_from_type(
+                vf.get(1, [b"ed25519"])[0].decode(), vf[2][0]
+            )
+            v = Validator(
+                pub_key=pk,
+                voting_power=vf.get(3, [0])[0],
+                proposer_priority=vf.get(4, [2**62])[0] - 2**62,
+            )
+            vals.append(v)
+        vs = cls.__new__(cls)
+        vs.validators = sorted(vals, key=lambda v: v.address)
+        vs._total_voting_power = None
+        vs.proposer = None
+        if 2 in f:
+            i, v = vs.get_by_address(f[2][0])
+            vs.proposer = v
+        return vs
+
+    def __repr__(self) -> str:
+        return f"ValidatorSet{{n={self.size()} tvp={self.total_voting_power()}}}"

@@ -1,0 +1,415 @@
+"""Core types tests — the reconstruction of the test suite the fork
+commented out (SURVEY.md §4.1: types/ block/vote/vote_set/validator_set
+tests all dead in the reference)."""
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519, merkle
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    BlockIDFlag,
+    Commit,
+    CommitSig,
+    Data,
+    DuplicateVoteEvidence,
+    Header,
+    PartSetHeader,
+    Proposal,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+    VoteType,
+)
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.priv_validator import MockPV
+from tendermint_tpu.types.vote_set import ConflictingVoteError
+
+CHAIN_ID = "test-chain"
+
+
+def make_valset(n, power=10):
+    pvs = [MockPV.from_secret(b"val%d" % i) for i in range(n)]
+    vals = [Validator(pv.get_pub_key(), power) for pv in pvs]
+    vs = ValidatorSet(vals)
+    # order privvals to match the sorted set
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    return vs, ordered
+
+
+def make_block_id(seed=b"blk"):
+    import hashlib
+
+    h = hashlib.sha256(seed).digest()
+    ph = hashlib.sha256(seed + b"p").digest()
+    return BlockID(hash=h, part_set_header=PartSetHeader(total=1, hash=ph))
+
+
+def make_vote(pv, vs, height, round_, vtype, block_id, ts=1_700_000_000_000_000_000):
+    addr = pv.get_pub_key().address()
+    idx, _ = vs.get_by_address(addr)
+    v = Vote(
+        type=vtype,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp_ns=ts,
+        validator_address=addr,
+        validator_index=idx,
+    )
+    pv.sign_vote(CHAIN_ID, v)
+    return v
+
+
+# --- merkle ---------------------------------------------------------------
+
+
+def test_merkle_proofs():
+    items = [b"a", b"bb", b"ccc", b"dddd", b"eeeee"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, item in enumerate(items):
+        assert proofs[i].verify(root, item)
+        assert not proofs[i].verify(root, item + b"!")
+    # single and empty
+    r1 = merkle.hash_from_byte_slices([b"x"])
+    assert r1 == merkle.leaf_hash(b"x")
+    import hashlib
+
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+
+# --- part sets ------------------------------------------------------------
+
+
+def test_part_set_roundtrip():
+    data = bytes(range(256)) * 1000  # 256 KB -> 4 parts
+    ps = PartSet.from_data(data)
+    assert ps.total == 4 and ps.is_complete()
+    # reassemble from gossiped parts
+    ps2 = PartSet(ps.header)
+    for i in [2, 0, 3, 1]:
+        assert ps2.add_part(ps.get_part(i))
+    assert ps2.is_complete()
+    assert ps2.get_bytes() == data
+
+
+def test_part_set_rejects_bad_proof():
+    ps = PartSet.from_data(b"hello world")
+    part = ps.get_part(0)
+    ps2 = PartSet(PartSetHeader(total=1, hash=b"\x00" * 32))
+    with pytest.raises(ValueError):
+        ps2.add_part(part)
+
+
+# --- vote sign bytes / encode --------------------------------------------
+
+
+def test_vote_roundtrip_and_verify():
+    vs, pvs = make_valset(4)
+    bid = make_block_id()
+    v = make_vote(pvs[0], vs, 5, 0, VoteType.PREVOTE, bid)
+    assert v.verify(CHAIN_ID, pvs[0].get_pub_key())
+    assert not v.verify("other-chain", pvs[0].get_pub_key())
+    rt = Vote.decode(v.encode())
+    assert rt == v
+
+
+def test_proposal_sign_bytes():
+    pv = MockPV.from_secret(b"p")
+    prop = Proposal(
+        height=3,
+        round=1,
+        pol_round=-1,
+        block_id=make_block_id(),
+        timestamp_ns=123456789,
+    )
+    pv.sign_proposal(CHAIN_ID, prop)
+    assert pv.get_pub_key().verify(prop.sign_bytes(CHAIN_ID), prop.signature)
+    rt = Proposal.decode(prop.encode())
+    assert rt == prop
+
+
+# --- header / block -------------------------------------------------------
+
+
+def make_header(vs, height=3):
+    return Header(
+        chain_id=CHAIN_ID,
+        height=height,
+        time_ns=1_700_000_000_000_000_000,
+        last_block_id=make_block_id(b"prev"),
+        validators_hash=vs.hash(),
+        next_validators_hash=vs.hash(),
+        consensus_hash=ConsensusParams().hash(),
+        app_hash=b"\x01" * 32,
+        proposer_address=vs.validators[0].address,
+    )
+
+
+def test_block_roundtrip():
+    vs, pvs = make_valset(4)
+    bid = make_block_id()
+    commit = Commit(
+        height=2,
+        round=0,
+        block_id=bid,
+        signatures=[
+            CommitSig(
+                BlockIDFlag.COMMIT,
+                vs.validators[i].address,
+                1_700_000_000_000_000_000 + i,
+                b"\x01" * 64,
+            )
+            for i in range(4)
+        ],
+    )
+    block = Block(
+        header=make_header(vs),
+        data=Data(txs=[b"tx1", b"tx2"], l2_block_meta=b"meta"),
+        last_commit=commit,
+    )
+    block.fill_header()
+    block.validate_basic()
+    rt = Block.decode(block.encode())
+    assert rt.hash() == block.hash()
+    assert rt.data.txs == [b"tx1", b"tx2"]
+    assert rt.last_commit.hash() == commit.hash()
+    # header hash covers batch_hash (morph capability)
+    b2 = Block.decode(block.encode())
+    b2.header.batch_hash = b"\x07" * 32
+    assert b2.hash() != block.hash()
+
+
+def test_block_validate_catches_tampering():
+    vs, _ = make_valset(1)
+    block = Block(header=make_header(vs, height=1), data=Data(txs=[b"tx"]))
+    block.fill_header()
+    block.validate_basic()
+    block.data.txs.append(b"evil")
+    block.data._hash = None
+    with pytest.raises(ValueError):
+        block.validate_basic()
+
+
+# --- validator set --------------------------------------------------------
+
+
+def test_proposer_rotation_weighted():
+    vs, _ = make_valset(3)
+    # equal powers -> round robin over 3 proposers, deterministic
+    seq = []
+    c = vs.copy()
+    for _ in range(6):
+        seq.append(c.get_proposer().address)
+        c.increment_proposer_priority(1)
+    assert set(seq[:3]) == {v.address for v in vs.validators}
+    assert seq[:3] == seq[3:6]
+
+
+def test_proposer_rotation_proportional():
+    pv1, pv2 = MockPV.from_secret(b"a"), MockPV.from_secret(b"b")
+    v1 = Validator(pv1.get_pub_key(), 90)
+    v2 = Validator(pv2.get_pub_key(), 10)
+    vs = ValidatorSet([v1, v2])
+    counts = {v1.address: 0, v2.address: 0}
+    c = vs.copy()
+    for _ in range(100):
+        counts[c.get_proposer().address] += 1
+        c.increment_proposer_priority(1)
+    assert counts[v1.address] == 90
+    assert counts[v2.address] == 10
+
+
+def test_validator_set_updates():
+    vs, _ = make_valset(3)
+    total0 = vs.total_voting_power()
+    new_pv = MockPV.from_secret(b"newval")
+    vs.update_with_change_set([Validator(new_pv.get_pub_key(), 5)])
+    assert vs.size() == 4
+    assert vs.total_voting_power() == total0 + 5
+    # power update
+    vs.update_with_change_set([Validator(new_pv.get_pub_key(), 7)])
+    assert vs.total_voting_power() == total0 + 7
+    # removal
+    vs.update_with_change_set([Validator(new_pv.get_pub_key(), 0)])
+    assert vs.size() == 3
+    with pytest.raises(ValueError):
+        vs.update_with_change_set([Validator(new_pv.get_pub_key(), 0)])
+
+
+def test_validator_set_encode_roundtrip():
+    vs, _ = make_valset(3)
+    vs.increment_proposer_priority(2)
+    rt = ValidatorSet.decode(vs.encode())
+    assert rt.hash() == vs.hash()
+    assert [v.proposer_priority for v in rt.validators] == [
+        v.proposer_priority for v in vs.validators
+    ]
+    assert rt.get_proposer().address == vs.get_proposer().address
+
+
+# --- vote set -------------------------------------------------------------
+
+
+def test_vote_set_two_thirds():
+    vs, pvs = make_valset(4)
+    voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+    bid = make_block_id()
+    assert not voteset.has_two_thirds_any()
+    for i in range(3):
+        added = voteset.add_vote(
+            make_vote(pvs[i], vs, 1, 0, VoteType.PREVOTE, bid)
+        )
+        assert added
+    maj, ok = voteset.two_thirds_majority()
+    assert ok and maj == bid
+    # duplicate returns False
+    assert not voteset.add_vote(
+        make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid)
+    )
+
+
+def test_vote_set_rejects_bad_signature():
+    vs, pvs = make_valset(4)
+    voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+    v = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, make_block_id())
+    v.signature = bytes(64)
+    with pytest.raises(ValueError):
+        voteset.add_vote(v)
+
+
+def test_vote_set_conflict_detected():
+    vs, pvs = make_valset(4)
+    voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+    v1 = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, make_block_id(b"x"))
+    v2 = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, make_block_id(b"y"))
+    voteset.add_vote(v1)
+    with pytest.raises(ConflictingVoteError) as ei:
+        voteset.add_vote(v2)
+    ev = DuplicateVoteEvidence.from_votes(
+        ei.value.existing, ei.value.new, vs.total_voting_power(), 10, 0
+    )
+    ev.validate_basic()
+
+
+def test_vote_set_make_commit():
+    vs, pvs = make_valset(4)
+    voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PRECOMMIT, vs)
+    bid = make_block_id()
+    for i in range(3):
+        voteset.add_vote(make_vote(pvs[i], vs, 1, 0, VoteType.PRECOMMIT, bid))
+    # one nil vote
+    voteset.add_vote(make_vote(pvs[3], vs, 1, 0, VoteType.PRECOMMIT, BlockID()))
+    commit = voteset.make_commit()
+    assert commit.size() == 4
+    flags = [cs.block_id_flag for cs in commit.signatures]
+    assert flags.count(BlockIDFlag.COMMIT) == 3
+    assert flags.count(BlockIDFlag.NIL) == 1
+    rt = Commit.decode(commit.encode())
+    assert rt.hash() == commit.hash()
+
+
+# --- commit verification via the TPU batch path ---------------------------
+
+
+def make_commit_for(vs, pvs, height, bid, nil_indices=()):
+    voteset = VoteSet(CHAIN_ID, height, 0, VoteType.PRECOMMIT, vs)
+    for i, pv in enumerate(pvs):
+        target = BlockID() if i in nil_indices else bid
+        voteset.add_vote(
+            make_vote(pv, vs, height, 0, VoteType.PRECOMMIT, target)
+        )
+    return voteset.make_commit()
+
+
+def test_verify_commit_light():
+    vs, pvs = make_valset(4)
+    bid = make_block_id()
+    commit = make_commit_for(vs, pvs, 3, bid, nil_indices=(3,))
+    vs.verify_commit_light(CHAIN_ID, bid, 3, commit)
+    vs.verify_commit(CHAIN_ID, bid, 3, commit)
+    vs.verify_commit_light_trusting(CHAIN_ID, commit)
+
+
+def test_verify_commit_insufficient_power():
+    vs, pvs = make_valset(4)
+    bid = make_block_id()
+    commit = make_commit_for(vs, pvs, 3, bid)
+    for i in (1, 2, 3):  # demote to NIL: signatures no longer count
+        commit.signatures[i].block_id_flag = BlockIDFlag.NIL
+    with pytest.raises(ValueError, match="insufficient"):
+        vs.verify_commit_light(CHAIN_ID, bid, 3, commit)
+
+
+def test_make_commit_rejects_nil_majority():
+    vs, pvs = make_valset(4)
+    voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PRECOMMIT, vs)
+    for pv in pvs:
+        voteset.add_vote(make_vote(pv, vs, 1, 0, VoteType.PRECOMMIT, BlockID()))
+    with pytest.raises(ValueError, match="nil"):
+        voteset.make_commit()
+
+
+def test_verify_commit_rejects_tampered_sig():
+    vs, pvs = make_valset(4)
+    bid = make_block_id()
+    commit = make_commit_for(vs, pvs, 3, bid)
+    commit.signatures[1].signature = bytes(64)
+    with pytest.raises(ValueError, match="wrong signature"):
+        vs.verify_commit(CHAIN_ID, bid, 3, commit)
+    # light variant: masked tally still has 3/4 power -> passes
+    vs.verify_commit_light(CHAIN_ID, bid, 3, commit)
+    commit.signatures[2].signature = bytes(64)
+    with pytest.raises(ValueError, match="insufficient"):
+        vs.verify_commit_light(CHAIN_ID, bid, 3, commit)
+
+
+def test_verify_commit_shape_checks():
+    vs, pvs = make_valset(4)
+    bid = make_block_id()
+    commit = make_commit_for(vs, pvs, 3, bid)
+    with pytest.raises(ValueError, match="height"):
+        vs.verify_commit_light(CHAIN_ID, bid, 4, commit)
+    with pytest.raises(ValueError, match="block id"):
+        vs.verify_commit_light(CHAIN_ID, make_block_id(b"z"), 3, commit)
+    small, _ = make_valset(3)
+    with pytest.raises(ValueError, match="size"):
+        small.verify_commit_light(CHAIN_ID, bid, 3, commit)
+
+
+# --- genesis / params -----------------------------------------------------
+
+
+def test_genesis_roundtrip(tmp_path):
+    vs, pvs = make_valset(2)
+    doc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        validators=[
+            GenesisValidator("ed25519", v.pub_key.data, v.voting_power)
+            for v in vs.validators
+        ],
+        app_state={"accounts": []},
+    )
+    doc.validate_and_complete()
+    path = str(tmp_path / "genesis.json")
+    doc.save_as(path)
+    rt = GenesisDoc.from_file(path)
+    assert rt.chain_id == CHAIN_ID
+    assert rt.validator_set().hash() == vs.hash()
+    assert rt.hash() == doc.hash()
+
+
+def test_consensus_params_update():
+    p = ConsensusParams()
+    p.validate()
+    p2 = p.update({"block": {"max_bytes": 1024}, "batch": {"blocks_interval": 5}})
+    assert p2.block.max_bytes == 1024
+    assert p2.batch.blocks_interval == 5
+    assert p.block.max_bytes != 1024  # original untouched
+    with pytest.raises(ValueError):
+        p.update({"block": {"max_bytes": -5}})
