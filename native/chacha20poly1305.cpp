@@ -1,0 +1,222 @@
+// ChaCha20-Poly1305 AEAD (RFC 8439) — the SecretConnection data path.
+//
+// Role (SURVEY.md §2.2): the reference's p2p encryption rides x/crypto's
+// assembly chacha20poly1305 (p2p/conn/secret_connection.go:92-182). This is
+// the framework's native equivalent: a small C++ implementation compiled to
+// a shared library and loaded via ctypes (no pybind11 in the image), with a
+// pure-Python fallback in tendermint_tpu/crypto/chacha.py.
+//
+// API (C ABI):
+//   int tm_aead_seal(key32, nonce12, pt, pt_len, ad, ad_len, out /*pt_len+16*/)
+//   int tm_aead_open(key32, nonce12, ct, ct_len, ad, ad_len, out /*ct_len-16*/)
+//     returns 0 on success, -1 on auth failure.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint32_t rotl32(uint32_t x, int n) { return (x << n) | (x >> (32 - n)); }
+
+inline uint32_t load32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+inline void store32(uint8_t* p, uint32_t v) {
+  p[0] = v & 0xff; p[1] = (v >> 8) & 0xff; p[2] = (v >> 16) & 0xff;
+  p[3] = (v >> 24) & 0xff;
+}
+
+#define QR(a, b, c, d)                                                  \
+  a += b; d ^= a; d = rotl32(d, 16);                                    \
+  c += d; b ^= c; b = rotl32(b, 12);                                    \
+  a += b; d ^= a; d = rotl32(d, 8);                                     \
+  c += d; b ^= c; b = rotl32(b, 7);
+
+void chacha20_block(const uint32_t state[16], uint8_t out[64]) {
+  uint32_t x[16];
+  std::memcpy(x, state, sizeof(x));
+  for (int i = 0; i < 10; i++) {
+    QR(x[0], x[4], x[8], x[12]) QR(x[1], x[5], x[9], x[13])
+    QR(x[2], x[6], x[10], x[14]) QR(x[3], x[7], x[11], x[15])
+    QR(x[0], x[5], x[10], x[15]) QR(x[1], x[6], x[11], x[12])
+    QR(x[2], x[7], x[8], x[13]) QR(x[3], x[4], x[9], x[14])
+  }
+  for (int i = 0; i < 16; i++) store32(out + 4 * i, x[i] + state[i]);
+}
+
+void chacha20_init(uint32_t state[16], const uint8_t key[32],
+                   const uint8_t nonce[12], uint32_t counter) {
+  state[0] = 0x61707865; state[1] = 0x3320646e;
+  state[2] = 0x79622d32; state[3] = 0x6b206574;
+  for (int i = 0; i < 8; i++) state[4 + i] = load32(key + 4 * i);
+  state[12] = counter;
+  for (int i = 0; i < 3; i++) state[13 + i] = load32(nonce + 4 * i);
+}
+
+void chacha20_xor(const uint8_t key[32], const uint8_t nonce[12],
+                  uint32_t counter, const uint8_t* in, size_t len,
+                  uint8_t* out) {
+  uint32_t state[16];
+  chacha20_init(state, key, nonce, counter);
+  uint8_t block[64];
+  while (len > 0) {
+    chacha20_block(state, block);
+    state[12]++;
+    size_t n = len < 64 ? len : 64;
+    for (size_t i = 0; i < n; i++) out[i] = in[i] ^ block[i];
+    in += n; out += n; len -= n;
+  }
+}
+
+// --- poly1305 (straightforward 26-bit limb implementation) ---------------
+
+struct Poly1305 {
+  uint32_t r[5], h[5], pad[4];
+  size_t leftover = 0;
+  uint8_t buffer[16];
+
+  void init(const uint8_t key[32]) {
+    r[0] = load32(key) & 0x3ffffff;
+    r[1] = (load32(key + 3) >> 2) & 0x3ffff03;
+    r[2] = (load32(key + 6) >> 4) & 0x3ffc0ff;
+    r[3] = (load32(key + 9) >> 6) & 0x3f03fff;
+    r[4] = (load32(key + 12) >> 8) & 0x00fffff;
+    h[0] = h[1] = h[2] = h[3] = h[4] = 0;
+    for (int i = 0; i < 4; i++) pad[i] = load32(key + 16 + 4 * i);
+  }
+
+  void blocks(const uint8_t* m, size_t len, uint32_t hibit) {
+    uint32_t r0 = r[0], r1 = r[1], r2 = r[2], r3 = r[3], r4 = r[4];
+    uint32_t s1 = r1 * 5, s2 = r2 * 5, s3 = r3 * 5, s4 = r4 * 5;
+    uint32_t h0 = h[0], h1 = h[1], h2 = h[2], h3 = h[3], h4 = h[4];
+    while (len >= 16) {
+      h0 += load32(m) & 0x3ffffff;
+      h1 += (load32(m + 3) >> 2) & 0x3ffffff;
+      h2 += (load32(m + 6) >> 4) & 0x3ffffff;
+      h3 += (load32(m + 9) >> 6) & 0x3ffffff;
+      h4 += (load32(m + 12) >> 8) | hibit;
+      uint64_t d0 = (uint64_t)h0 * r0 + (uint64_t)h1 * s4 + (uint64_t)h2 * s3 +
+                    (uint64_t)h3 * s2 + (uint64_t)h4 * s1;
+      uint64_t d1 = (uint64_t)h0 * r1 + (uint64_t)h1 * r0 + (uint64_t)h2 * s4 +
+                    (uint64_t)h3 * s3 + (uint64_t)h4 * s2;
+      uint64_t d2 = (uint64_t)h0 * r2 + (uint64_t)h1 * r1 + (uint64_t)h2 * r0 +
+                    (uint64_t)h3 * s4 + (uint64_t)h4 * s3;
+      uint64_t d3 = (uint64_t)h0 * r3 + (uint64_t)h1 * r2 + (uint64_t)h2 * r1 +
+                    (uint64_t)h3 * r0 + (uint64_t)h4 * s4;
+      uint64_t d4 = (uint64_t)h0 * r4 + (uint64_t)h1 * r3 + (uint64_t)h2 * r2 +
+                    (uint64_t)h3 * r1 + (uint64_t)h4 * r0;
+      uint64_t c;
+      c = d0 >> 26; h0 = d0 & 0x3ffffff; d1 += c;
+      c = d1 >> 26; h1 = d1 & 0x3ffffff; d2 += c;
+      c = d2 >> 26; h2 = d2 & 0x3ffffff; d3 += c;
+      c = d3 >> 26; h3 = d3 & 0x3ffffff; d4 += c;
+      c = d4 >> 26; h4 = d4 & 0x3ffffff; h0 += (uint32_t)c * 5;
+      c = h0 >> 26; h0 &= 0x3ffffff; h1 += (uint32_t)c;
+      m += 16; len -= 16;
+    }
+    h[0] = h0; h[1] = h1; h[2] = h2; h[3] = h3; h[4] = h4;
+  }
+
+  void update(const uint8_t* m, size_t len) {
+    if (leftover) {
+      size_t want = 16 - leftover;
+      if (want > len) want = len;
+      std::memcpy(buffer + leftover, m, want);
+      leftover += want; m += want; len -= want;
+      if (leftover < 16) return;
+      blocks(buffer, 16, 1 << 24);
+      leftover = 0;
+    }
+    size_t full = len & ~(size_t)15;
+    if (full) { blocks(m, full, 1 << 24); m += full; len -= full; }
+    if (len) { std::memcpy(buffer, m, len); leftover = len; }
+  }
+
+  void finish(uint8_t tag[16]) {
+    if (leftover) {
+      buffer[leftover] = 1;
+      for (size_t i = leftover + 1; i < 16; i++) buffer[i] = 0;
+      blocks(buffer, 16, 0);
+    }
+    uint32_t h0 = h[0], h1 = h[1], h2 = h[2], h3 = h[3], h4 = h[4];
+    uint32_t c;
+    c = h1 >> 26; h1 &= 0x3ffffff; h2 += c;
+    c = h2 >> 26; h2 &= 0x3ffffff; h3 += c;
+    c = h3 >> 26; h3 &= 0x3ffffff; h4 += c;
+    c = h4 >> 26; h4 &= 0x3ffffff; h0 += c * 5;
+    c = h0 >> 26; h0 &= 0x3ffffff; h1 += c;
+    uint32_t g0 = h0 + 5; c = g0 >> 26; g0 &= 0x3ffffff;
+    uint32_t g1 = h1 + c; c = g1 >> 26; g1 &= 0x3ffffff;
+    uint32_t g2 = h2 + c; c = g2 >> 26; g2 &= 0x3ffffff;
+    uint32_t g3 = h3 + c; c = g3 >> 26; g3 &= 0x3ffffff;
+    uint32_t g4 = h4 + c - (1 << 26);
+    uint32_t mask = (g4 >> 31) - 1;
+    h0 = (h0 & ~mask) | (g0 & mask);
+    h1 = (h1 & ~mask) | (g1 & mask);
+    h2 = (h2 & ~mask) | (g2 & mask);
+    h3 = (h3 & ~mask) | (g3 & mask);
+    h4 = (h4 & ~mask) | (g4 & mask);
+    uint64_t f;
+    uint32_t o0 = h0 | (h1 << 26);
+    uint32_t o1 = (h1 >> 6) | (h2 << 20);
+    uint32_t o2 = (h2 >> 12) | (h3 << 14);
+    uint32_t o3 = (h3 >> 18) | (h4 << 8);
+    f = (uint64_t)o0 + pad[0]; store32(tag, (uint32_t)f);
+    f = (uint64_t)o1 + pad[1] + (f >> 32); store32(tag + 4, (uint32_t)f);
+    f = (uint64_t)o2 + pad[2] + (f >> 32); store32(tag + 8, (uint32_t)f);
+    f = (uint64_t)o3 + pad[3] + (f >> 32); store32(tag + 12, (uint32_t)f);
+  }
+};
+
+void poly1305_aead_tag(const uint8_t key[32], const uint8_t nonce[12],
+                       const uint8_t* ad, size_t ad_len, const uint8_t* ct,
+                       size_t ct_len, uint8_t tag[16]) {
+  uint8_t polykey[64];
+  uint32_t state[16];
+  chacha20_init(state, key, nonce, 0);
+  chacha20_block(state, polykey);
+  Poly1305 poly;
+  poly.init(polykey);
+  static const uint8_t zeros[16] = {0};
+  poly.update(ad, ad_len);
+  if (ad_len % 16) poly.update(zeros, 16 - (ad_len % 16));
+  poly.update(ct, ct_len);
+  if (ct_len % 16) poly.update(zeros, 16 - (ct_len % 16));
+  uint8_t lens[16];
+  for (int i = 0; i < 8; i++) {
+    lens[i] = (ad_len >> (8 * i)) & 0xff;
+    lens[8 + i] = (ct_len >> (8 * i)) & 0xff;
+  }
+  poly.update(lens, 16);
+  poly.finish(tag);
+}
+
+}  // namespace
+
+extern "C" {
+
+int tm_aead_seal(const uint8_t* key, const uint8_t* nonce, const uint8_t* pt,
+                 size_t pt_len, const uint8_t* ad, size_t ad_len,
+                 uint8_t* out) {
+  chacha20_xor(key, nonce, 1, pt, pt_len, out);
+  poly1305_aead_tag(key, nonce, ad, ad_len, out, pt_len, out + pt_len);
+  return 0;
+}
+
+int tm_aead_open(const uint8_t* key, const uint8_t* nonce, const uint8_t* ct,
+                 size_t ct_len, const uint8_t* ad, size_t ad_len,
+                 uint8_t* out) {
+  if (ct_len < 16) return -1;
+  size_t pt_len = ct_len - 16;
+  uint8_t tag[16];
+  poly1305_aead_tag(key, nonce, ad, ad_len, ct, pt_len, tag);
+  uint8_t diff = 0;
+  for (int i = 0; i < 16; i++) diff |= tag[i] ^ ct[pt_len + i];
+  if (diff) return -1;
+  chacha20_xor(key, nonce, 1, ct, pt_len, out);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,2 @@
+"""Consensus engine (SURVEY.md layer 7, reference consensus/ ~7.7k LoC):
+WAL, state machine, timeout ticker, gossip reactor, handshake replay."""

@@ -1,0 +1,93 @@
+"""HeightVoteSet — all VoteSets (prevote+precommit per round) of one height.
+
+Reference: consensus/types/height_vote_set.go: lazily creates round vote
+sets; tracks which rounds a peer has claimed catch-up majorities for
+(SetPeerMaj23); surfaces equivocation as ErrVoteConflictingVotes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..types.validator_set import ValidatorSet
+from ..types.vote import Vote, VoteType
+from ..types.vote_set import ConflictingVoteError, VoteSet
+
+
+class HeightVoteSet:
+    MAX_CATCHUP_ROUNDS = 2  # peer-triggered rounds beyond current
+
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self.round = 0
+        self._rounds: dict[int, dict[int, VoteSet]] = {}
+        self._peer_catchup_rounds: dict[str, list[int]] = {}
+        self.set_round(0)
+
+    def set_round(self, round_: int) -> None:
+        """Ensure vote sets exist up to round_ + 1 (reference SetRound)."""
+        for r in range(self.round, round_ + 2):
+            self._ensure_round(r)
+        self.round = round_
+
+    def _ensure_round(self, round_: int) -> None:
+        if round_ in self._rounds:
+            return
+        self._rounds[round_] = {
+            VoteType.PREVOTE: VoteSet(
+                self.chain_id, self.height, round_, VoteType.PREVOTE, self.val_set
+            ),
+            VoteType.PRECOMMIT: VoteSet(
+                self.chain_id,
+                self.height,
+                round_,
+                VoteType.PRECOMMIT,
+                self.val_set,
+            ),
+        }
+
+    def prevotes(self, round_: int) -> Optional[VoteSet]:
+        return self._rounds.get(round_, {}).get(VoteType.PREVOTE)
+
+    def precommits(self, round_: int) -> Optional[VoteSet]:
+        return self._rounds.get(round_, {}).get(VoteType.PRECOMMIT)
+
+    def add_vote(
+        self, vote: Vote, peer_id: str = "", verified: bool = False
+    ) -> bool:
+        """Returns True if added. Rounds beyond current+1 are only created
+        for peers that earned them via SetPeerMaj23 (reference addVote)."""
+        if vote.round > self.round + 1:
+            rounds = self._peer_catchup_rounds.get(peer_id, [])
+            if vote.round not in rounds:
+                raise ValueError(
+                    "unexpected round in peer vote (no maj23 claim)"
+                )
+        self._ensure_round(vote.round)
+        return self._rounds[vote.round][vote.type].add_vote(
+            vote, verified=verified
+        )
+
+    def set_peer_maj23(
+        self, round_: int, vote_type: int, peer_id: str, block_id
+    ) -> None:
+        self._ensure_round(round_)
+        rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+        if round_ not in rounds:
+            if len(rounds) >= self.MAX_CATCHUP_ROUNDS:
+                raise ValueError("peer has too many catchup rounds")
+            rounds.append(round_)
+        self._rounds[round_][vote_type].set_peer_maj23(peer_id, block_id)
+
+    def pol_info(self) -> tuple[int, object]:
+        """(round, blockID) of the most recent prevote polka, or (-1, None)
+        (reference POLInfo)."""
+        for r in range(self.round, -1, -1):
+            pv = self.prevotes(r)
+            if pv is not None:
+                bid, ok = pv.two_thirds_majority()
+                if ok:
+                    return r, bid
+        return -1, None

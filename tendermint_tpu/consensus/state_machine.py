@@ -1,0 +1,1098 @@
+"""The consensus state machine — Tendermint BFT as a single async loop.
+
+Reference: consensus/state.go (State :85-160, receiveRoutine :766-855,
+enterNewRound :1035 → enterPropose :1119 → enterPrevote :1380 →
+enterPrecommit :1532 → enterCommit :1694 → finalizeCommit :1785-1948,
+addVote :2274-2519, signVote :2522). The single-goroutine event loop over
+(peer msgs, internal msgs, timeouts) is preserved — it is already the
+right shape for determinism (SURVEY.md §2.3) — as one asyncio task.
+
+Morph deltas reproduced:
+- no mempool: proposals pull txs from the L2 notifier
+  (defaultDecideProposal :1192 → createProposalBlock :1267),
+- batch points: decideBatchPoint :1318-1362 (CalculateCap → SealBatch →
+  batch hash into the header), BLS dual-sign on batch-point precommits
+  (signVote :2522-2572) and BLS verification inside addVote :2362-2379,
+- upgrade switch: at UpgradeBlockHeight, finalizeCommit stops BFT and
+  hands off to sequencer mode (state.go:1921-1938).
+
+Vote verification: incoming votes carry signatures verified through the
+BatchVerifier (host fast path for singles, TPU for batches — the
+micro-batching tradeoff); VoteSet inserts with verified=True.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..crypto.batch_verifier import BatchVerifier, SigItem, default_verifier
+from ..l2node.l2node import BlockData, BlsData, L2Node
+from ..libs import fail
+from ..libs.events import EventSwitch
+from ..libs.log import Logger, nop_logger
+from ..state.execution import BlockExecutor
+from ..state.state import State
+from ..store.block_store import BlockStore
+from ..types.block import Block, Commit
+from ..types.block_id import BlockID
+from ..types.evidence import DuplicateVoteEvidence
+from ..types.part_set import Part, PartSet
+from ..types.proposal import Proposal
+from ..types.vote import Vote, VoteType
+from ..types.vote_set import ConflictingVoteError, VoteSet
+from .height_vote_set import HeightVoteSet
+from .messages import BlockPartMessage, ProposalMessage, VoteMessage
+from .ticker import TimeoutInfo, TimeoutTicker
+from .wal import WAL, NilWAL, WALMessage
+
+
+class Step(enum.IntEnum):
+    NEW_HEIGHT = 1
+    NEW_ROUND = 2
+    PROPOSE = 3
+    PREVOTE = 4
+    PREVOTE_WAIT = 5
+    PRECOMMIT = 6
+    PRECOMMIT_WAIT = 7
+    COMMIT = 8
+
+
+@dataclass
+class ConsensusConfig:
+    """Timeouts (reference config/config.go:826-877 ConsensusConfig)."""
+
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+
+    def propose(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+    @classmethod
+    def test_config(cls) -> "ConsensusConfig":
+        return cls(
+            timeout_propose=0.4,
+            timeout_propose_delta=0.1,
+            timeout_prevote=0.2,
+            timeout_prevote_delta=0.1,
+            timeout_precommit=0.2,
+            timeout_precommit_delta=0.1,
+            timeout_commit=0.05,
+            skip_timeout_commit=True,
+        )
+
+
+# event-switch event names (reactor fast path)
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_VOTE = "Vote"
+EVENT_PROPOSAL_BLOCK_PART = "ProposalBlockPart"
+EVENT_VALID_BLOCK = "ValidBlock"
+
+
+@dataclass
+class RoundState:
+    """Snapshot of the current round (reference consensus/types/
+    round_state.go) — what the reactor gossips from."""
+
+    height: int = 0
+    round: int = 0
+    step: Step = Step.NEW_HEIGHT
+    start_time_ns: int = 0
+    proposal: Optional[Proposal] = None
+    proposal_block: Optional[Block] = None
+    proposal_block_parts: Optional[PartSet] = None
+    locked_round: int = -1
+    locked_block: Optional[Block] = None
+    locked_block_parts: Optional[PartSet] = None
+    valid_round: int = -1
+    valid_block: Optional[Block] = None
+    valid_block_parts: Optional[PartSet] = None
+    votes: Optional[HeightVoteSet] = None
+    commit_round: int = -1
+    last_commit: Optional[VoteSet] = None
+    triggered_timeout_precommit: bool = False
+
+
+class ConsensusState:
+    """One instance per node. start() spawns the receive routine."""
+
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state: State,
+        executor: BlockExecutor,
+        block_store: BlockStore,
+        l2_node: L2Node,
+        notifier=None,
+        priv_validator=None,
+        event_bus=None,
+        wal=None,
+        verifier: Optional[BatchVerifier] = None,
+        bls_signer: Optional[Callable[[bytes], bytes]] = None,
+        upgrade_height: int = 0,
+        on_upgrade: Optional[Callable] = None,
+        evidence_pool=None,
+        logger: Optional[Logger] = None,
+        now_ns: Callable[[], int] = time.time_ns,
+    ):
+        self.config = config
+        self.executor = executor
+        self.block_store = block_store
+        self.l2 = l2_node
+        self.notifier = notifier
+        self.priv_validator = priv_validator
+        self.event_bus = event_bus
+        self.wal = wal or NilWAL()
+        self.verifier = verifier or default_verifier()
+        self.bls_signer = bls_signer
+        self.upgrade_height = upgrade_height
+        self.on_upgrade = on_upgrade
+        self.evpool = evidence_pool
+        self.logger = logger or nop_logger()
+        self.now_ns = now_ns
+
+        self.event_switch = EventSwitch()
+
+        self.state: State = state  # committed state (height = last block)
+        self.rs = RoundState()
+        self._privval_pubkey = None
+
+        self.peer_msg_queue: asyncio.Queue = asyncio.Queue(1000)
+        self.internal_msg_queue: asyncio.Queue = asyncio.Queue(1000)
+        self.ticker = TimeoutTicker()
+        self._receive_task: Optional[asyncio.Task] = None
+        self._stopped = asyncio.Event()
+        self._running = False
+        self._decided_batch: Optional[tuple[bytes, bytes]] = None  # hash, header
+        # height -> asyncio.Event fired after finalize (test hook)
+        self._height_waiters: dict[int, asyncio.Event] = {}
+        # called with each self-produced message (proposal/part/vote); the
+        # reactor uses the event switch instead — this hook is the in-proc
+        # harness's stand-in for gossip (reconstructing the deleted
+        # consensus/common_test.go net, SURVEY.md §4.1)
+        self.broadcast_hook: Optional[Callable] = None
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        if self.priv_validator is not None:
+            pk = self.priv_validator.get_pub_key()
+            if asyncio.iscoroutine(pk):
+                pk = await pk
+            self._privval_pubkey = pk
+        self._update_to_state(self.state)
+        # crash recovery: re-feed in-flight WAL messages before going live
+        # (reference catchupReplay, consensus/replay.go:95-173)
+        if not isinstance(self.wal, NilWAL):
+            from .replay import catchup_replay
+
+            n = await catchup_replay(self, self.wal)
+            if n:
+                self.logger.info("replayed WAL messages", count=n)
+        self._running = True
+        self._receive_task = asyncio.get_running_loop().create_task(
+            self._receive_routine(), name="consensus/receive"
+        )
+        self._schedule_round_0()
+
+    async def stop(self) -> None:
+        self._running = False
+        self.ticker.stop()
+        if self._receive_task:
+            self._receive_task.cancel()
+            try:
+                await self._receive_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self.wal.flush_and_sync()
+        self._stopped.set()
+
+    async def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
+        """Test/RPC hook: block until `height` is committed."""
+        if self.state.last_block_height >= height:
+            return
+        ev = self._height_waiters.setdefault(height, asyncio.Event())
+        await asyncio.wait_for(ev.wait(), timeout)
+
+    # --- external input ---------------------------------------------------
+
+    async def add_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        await self.peer_msg_queue.put((ProposalMessage(proposal), peer_id))
+
+    async def add_block_part(
+        self, height: int, round_: int, part: Part, peer_id: str = ""
+    ) -> None:
+        await self.peer_msg_queue.put(
+            (BlockPartMessage(height, round_, part), peer_id)
+        )
+
+    async def add_vote(self, vote: Vote, peer_id: str = "") -> None:
+        await self.peer_msg_queue.put((VoteMessage(vote), peer_id))
+
+    # --- the event loop ---------------------------------------------------
+
+    async def _receive_routine(self) -> None:
+        """The single serialization point (reference receiveRoutine :766):
+        every message is WAL-logged before it is processed."""
+        while self._running:
+            peer_get = asyncio.ensure_future(self.peer_msg_queue.get())
+            internal_get = asyncio.ensure_future(self.internal_msg_queue.get())
+            tock_get = asyncio.ensure_future(self.ticker.tock_queue.get())
+            done, pending = await asyncio.wait(
+                [peer_get, internal_get, tock_get],
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            for p in pending:
+                p.cancel()
+            # each branch gets its own failure isolation: a bad peer
+            # message must not swallow an already-dequeued timeout or our
+            # own internal message
+            if internal_get in done:
+                msg, peer_id = internal_get.result()
+                try:
+                    self._wal_write(msg, sync=True)
+                    await self._handle_msg(msg, peer_id)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    self.logger.error("internal msg failed", err=repr(e))
+            if peer_get in done:
+                msg, peer_id = peer_get.result()
+                try:
+                    self._wal_write(msg, sync=False)
+                    await self._handle_msg(msg, peer_id)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    self.logger.error(
+                        "peer msg failed", peer=peer_id, err=repr(e)
+                    )
+            if tock_get in done:
+                ti = tock_get.result()
+                try:
+                    self.wal.write(WALMessage("timeout", _encode_timeout(ti)))
+                    await self._handle_timeout(ti)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    self.logger.error("timeout handling failed", err=repr(e))
+
+    def _wal_write(self, msg, sync: bool) -> None:
+        try:
+            kind, data = _encode_wal_msg(msg)
+        except Exception:
+            return
+        if sync:
+            self.wal.write_sync(WALMessage(kind, data))
+        else:
+            self.wal.write(WALMessage(kind, data))
+
+    async def _handle_msg(self, msg, peer_id: str) -> None:
+        if isinstance(msg, ProposalMessage):
+            self._set_proposal(msg.proposal)
+        elif isinstance(msg, BlockPartMessage):
+            added = self._add_proposal_block_part(msg)
+            if added:
+                await self._handle_complete_proposal(msg.height)
+        elif isinstance(msg, VoteMessage):
+            await self._try_add_vote(msg.vote, peer_id)
+        else:
+            self.logger.error("unknown msg type", msg=type(msg).__name__)
+
+    async def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        rs = self.rs
+        if (
+            ti.height != rs.height
+            or ti.round < rs.round
+            or (ti.round == rs.round and ti.step < rs.step)
+        ):
+            return  # stale
+        if ti.step == Step.NEW_HEIGHT:
+            await self._enter_new_round(ti.height, 0)
+        elif ti.step == Step.NEW_ROUND:
+            await self._enter_propose(ti.height, 0)
+        elif ti.step == Step.PROPOSE:
+            await self._enter_prevote(ti.height, ti.round)
+        elif ti.step == Step.PREVOTE_WAIT:
+            await self._enter_precommit(ti.height, ti.round)
+        elif ti.step == Step.PRECOMMIT_WAIT:
+            await self._enter_precommit(ti.height, ti.round)
+            await self._enter_new_round(ti.height, ti.round + 1)
+
+    # --- round transitions ------------------------------------------------
+
+    def _schedule_round_0(self) -> None:
+        sleep = max(
+            0.0, (self.rs.start_time_ns - self.now_ns()) / 1e9
+        )
+        self.ticker.schedule(
+            TimeoutInfo(sleep, self.rs.height, 0, Step.NEW_HEIGHT)
+        )
+
+    def _schedule_timeout(
+        self, duration_s: float, height: int, round_: int, step: Step
+    ) -> None:
+        self.ticker.schedule(TimeoutInfo(duration_s, height, round_, step))
+
+    def _new_step(self) -> None:
+        self.event_switch.fire_event(EVENT_NEW_ROUND_STEP, self.rs)
+
+    async def _enter_new_round(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if height != rs.height or round_ < rs.round or (
+            round_ == rs.round and rs.step != Step.NEW_HEIGHT
+        ):
+            return
+        if round_ > rs.round:
+            # round catchup: increment proposer priority view
+            pass
+        rs.round = round_
+        rs.step = Step.NEW_ROUND
+        if round_ > 0:
+            # new round wipes the proposal (unless re-proposing valid block)
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_)
+        rs.triggered_timeout_precommit = False
+        self._new_step()
+        if self.event_bus is not None:
+            await self.event_bus.publish_new_round(
+                (height, round_, self._proposer_address(round_))
+            )
+        await self._enter_propose(height, round_)
+
+    def _proposer_for_round(self, round_: int):
+        vals = self.state.validators
+        if round_ == 0:
+            return vals.get_proposer()
+        return vals.copy_increment_proposer_priority(round_).get_proposer()
+
+    def _proposer_address(self, round_: int) -> bytes:
+        return self._proposer_for_round(round_).address
+
+    def _is_proposer(self, round_: int) -> bool:
+        return (
+            self._privval_pubkey is not None
+            and self._proposer_address(round_) == self._privval_pubkey.address()
+        )
+
+    async def _enter_propose(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= Step.PROPOSE
+        ):
+            return
+        rs.step = Step.PROPOSE
+        self._new_step()
+        self._schedule_timeout(
+            self.config.propose(round_), height, round_, Step.PROPOSE
+        )
+        if self._is_proposer(round_):
+            await self._decide_proposal(height, round_)
+        # if we already have a complete proposal (e.g. from a peer or a
+        # valid block), move on immediately
+        if self._is_proposal_complete():
+            await self._enter_prevote(height, round_)
+
+    async def _decide_proposal(self, height: int, round_: int) -> None:
+        """defaultDecideProposal (reference :1192): build or re-propose."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, parts = rs.valid_block, rs.valid_block_parts
+        else:
+            block, parts = await self._create_proposal_block(height)
+            if block is None:
+                return
+        bid = BlockID(block.hash(), parts.header)
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=bid,
+            timestamp_ns=self.now_ns(),
+        )
+        try:
+            res = self.priv_validator.sign_proposal(
+                self.state.chain_id, proposal
+            )
+            if asyncio.iscoroutine(res):
+                await res
+        except Exception as e:
+            self.logger.error("failed to sign proposal", err=repr(e))
+            return
+        await self.internal_msg_queue.put((ProposalMessage(proposal), ""))
+        if self.broadcast_hook is not None:
+            self.broadcast_hook(ProposalMessage(proposal))
+        for i in range(parts.total):
+            part_msg = BlockPartMessage(height, round_, parts.get_part(i))
+            await self.internal_msg_queue.put((part_msg, ""))
+            if self.broadcast_hook is not None:
+                self.broadcast_hook(part_msg)
+
+    async def _create_proposal_block(
+        self, height: int
+    ) -> tuple[Optional[Block], Optional[PartSet]]:
+        """createProposalBlock + decideBatchPoint (reference :1267, :1318)."""
+        if self.notifier is not None:
+            block_data = self.notifier.get_block_data(height)
+        else:
+            block_data = self.l2.request_block_data(height)
+        last_commit = None
+        if height > self.state.initial_height:
+            if (
+                self.rs.last_commit is not None
+                and self.rs.last_commit.has_two_thirds_majority()
+            ):
+                last_commit = self.rs.last_commit.make_commit()
+            else:
+                last_commit = self.block_store.load_seen_commit(height - 1)
+                if last_commit is None:
+                    self.logger.error("no last commit; cannot propose")
+                    return None, None
+        block_time = max(self.now_ns(), self.state.last_block_time_ns + 1)
+        block = self.executor.create_proposal_block(
+            height,
+            self.state,
+            last_commit,
+            self._privval_pubkey.address(),
+            block_data,
+            block_time,
+        )
+        # decideBatchPoint (reference :1318-1362): ask the L2 node whether
+        # this block seals the batch; if so the header carries the batch
+        # hash and the data carries the sealed header.
+        self._decided_batch = None
+        if self.l2.calculate_batch_size_with_proposal_block(
+            block.encode(), False
+        ):
+            batch_hash, batch_header = self.l2.seal_batch()
+            block.set_batch_point(batch_hash, batch_header)
+            self._decided_batch = (batch_hash, batch_header)
+        parts = block.make_part_set()
+        return block, parts
+
+    def _is_proposal_complete(self) -> bool:
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        pv = rs.votes.prevotes(rs.proposal.pol_round)
+        return pv is not None and pv.has_two_thirds_majority()
+
+    # --- proposal / parts -------------------------------------------------
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """defaultSetProposal: verify the proposer's signature."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            0 <= proposal.pol_round >= proposal.round
+        ):
+            raise ValueError("invalid proposal POL round")
+        proposer = self._proposer_for_round(rs.round)
+        if not proposer.pub_key.verify(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        ):
+            raise ValueError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage) -> bool:
+        rs = self.rs
+        if msg.height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        if rs.proposal_block is not None:
+            return False  # already complete
+        try:
+            added = rs.proposal_block_parts.add_part(msg.part)
+        except ValueError:
+            raise
+        if added and rs.proposal_block_parts.is_complete():
+            rs.proposal_block = Block.decode(
+                rs.proposal_block_parts.get_bytes()
+            )
+            self.event_switch.fire_event(EVENT_PROPOSAL_BLOCK_PART, rs)
+        return added
+
+    async def _handle_complete_proposal(self, height: int) -> None:
+        rs = self.rs
+        if rs.proposal_block is None:
+            return
+        prevotes = rs.votes.prevotes(rs.round)
+        bid, has_polka = (
+            prevotes.two_thirds_majority() if prevotes else (None, False)
+        )
+        if has_polka and not bid.is_zero() and rs.valid_round < rs.round:
+            if rs.proposal_block.hash() == bid.hash:
+                rs.valid_round = rs.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= Step.PROPOSE and self._is_proposal_complete():
+            await self._enter_prevote(height, rs.round)
+            if has_polka:
+                await self._enter_precommit(height, rs.round)
+        elif rs.step == Step.COMMIT:
+            await self._try_finalize_commit(height)
+
+    # --- prevote ----------------------------------------------------------
+
+    async def _enter_prevote(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= Step.PREVOTE
+        ):
+            return
+        rs.step = Step.PREVOTE
+        self._new_step()
+        await self._do_prevote(height, round_)
+
+    async def _do_prevote(self, height: int, round_: int) -> None:
+        """defaultDoPrevote (reference :1406): locked block > valid
+        proposal > nil."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            await self._sign_add_vote(
+                VoteType.PREVOTE,
+                rs.locked_block.hash(),
+                rs.locked_block_parts.header,
+            )
+            return
+        if rs.proposal_block is None:
+            await self._sign_add_vote(VoteType.PREVOTE, b"", None)
+            return
+        try:
+            self.executor.validate_block(self.state, rs.proposal_block)
+            ok = self.executor.process_proposal(self.state, rs.proposal_block)
+            if not ok:
+                raise ValueError("CheckBlockData rejected proposal")
+            # batch-point consistency: a batch hash in the header must match
+            # what the L2 node computes from the carried batch header
+            bh = rs.proposal_block.header.batch_hash
+            if bh:
+                expect = self.l2.batch_hash(
+                    rs.proposal_block.data.l2_batch_header
+                )
+                if expect != bh:
+                    raise ValueError("batch hash mismatch in proposal")
+        except ValueError as e:
+            self.logger.info("prevoting nil: invalid proposal", err=repr(e))
+            await self._sign_add_vote(VoteType.PREVOTE, b"", None)
+            return
+        await self._sign_add_vote(
+            VoteType.PREVOTE,
+            rs.proposal_block.hash(),
+            rs.proposal_block_parts.header,
+        )
+
+    async def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= Step.PREVOTE_WAIT
+        ):
+            return
+        rs.step = Step.PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(
+            self.config.prevote(round_), height, round_, Step.PREVOTE_WAIT
+        )
+
+    # --- precommit --------------------------------------------------------
+
+    async def _enter_precommit(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= Step.PRECOMMIT
+        ):
+            return
+        rs.step = Step.PRECOMMIT
+        self._new_step()
+        prevotes = rs.votes.prevotes(round_)
+        bid, ok = (
+            prevotes.two_thirds_majority() if prevotes else (None, False)
+        )
+        if not ok:
+            # no polka: precommit nil
+            await self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+            return
+        if bid.is_zero():
+            # polka for nil: unlock (reference :1625-1643)
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            if self.event_bus is not None:
+                await self.event_bus.publish_unlock(rs)
+            await self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+            return
+        # polka for a block
+        if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
+            # relock
+            rs.locked_round = round_
+            if self.event_bus is not None:
+                await self.event_bus.publish_relock(rs)
+            await self._sign_add_vote(
+                VoteType.PRECOMMIT, bid.hash, bid.part_set_header
+            )
+            return
+        if (
+            rs.proposal_block is not None
+            and rs.proposal_block.hash() == bid.hash
+        ):
+            try:
+                self.executor.validate_block(self.state, rs.proposal_block)
+            except ValueError as e:
+                raise RuntimeError(
+                    f"+2/3 prevoted an invalid block: {e}"
+                ) from e
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            if self.event_bus is not None:
+                await self.event_bus.publish_lock(rs)
+            await self._sign_add_vote(
+                VoteType.PRECOMMIT, bid.hash, bid.part_set_header
+            )
+            return
+        # polka for a block we don't have: unlock, fetch it, precommit nil
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+            bid.part_set_header
+        ):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(bid.part_set_header)
+        if self.event_bus is not None:
+            await self.event_bus.publish_unlock(rs)
+        await self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+
+    async def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ != rs.round or (
+            rs.triggered_timeout_precommit
+        ):
+            return
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(
+            self.config.precommit(round_), height, round_, Step.PRECOMMIT_WAIT
+        )
+
+    # --- commit -----------------------------------------------------------
+
+    async def _enter_commit(self, height: int, commit_round: int) -> None:
+        rs = self.rs
+        if rs.height != height or rs.step >= Step.COMMIT:
+            return
+        rs.step = Step.COMMIT
+        rs.commit_round = commit_round
+        self._new_step()
+        precommits = rs.votes.precommits(commit_round)
+        bid, ok = precommits.two_thirds_majority()
+        if not ok or bid.is_zero():
+            raise RuntimeError("enterCommit without +2/3 block precommits")
+        # if we locked the block, it is the proposal block
+        if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if (
+            rs.proposal_block is None
+            or rs.proposal_block.hash() != bid.hash
+        ):
+            if rs.proposal_block_parts is None or not (
+                rs.proposal_block_parts.has_header(bid.part_set_header)
+            ):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(bid.part_set_header)
+                self.event_switch.fire_event(EVENT_VALID_BLOCK, rs)
+        await self._try_finalize_commit(height)
+
+    async def _try_finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        if rs.height != height:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        bid, ok = precommits.two_thirds_majority()
+        if not ok or bid.is_zero():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != bid.hash:
+            return  # waiting for the block parts
+        await self._finalize_commit(height)
+
+    async def _finalize_commit(self, height: int) -> None:
+        """finalizeCommit (reference :1785-1948)."""
+        rs = self.rs
+        precommits = rs.votes.precommits(rs.commit_round)
+        bid, _ = precommits.two_thirds_majority()
+        block, parts = rs.proposal_block, rs.proposal_block_parts
+
+        block.validate_basic()
+        fail.fail_point()
+        # save block + seen commit
+        if self.block_store.height < height:
+            seen_commit = precommits.make_commit()
+            self.block_store.save_block(block, parts, seen_commit)
+        fail.fail_point()
+        # WAL barrier: after this record, the height is decided
+        self.wal.write_end_height(height)
+        fail.fail_point()
+
+        # collect BLS contributions for batch points (morph)
+        bls_datas = []
+        if block.header.batch_hash:
+            for v in precommits.votes:
+                if v is not None and v.bls_signature:
+                    bls_datas.append(
+                        BlsData(
+                            signer=v.validator_address,
+                            signature=v.bls_signature,
+                        )
+                    )
+        state_copy = self.state.copy()
+        new_state = await self.executor.apply_block(
+            state_copy, bid, block, bls_datas
+        )
+        fail.fail_point()
+
+        # upgrade switch (reference state.go:1921-1938 + upgrade/upgrade.go)
+        if self.upgrade_height and height >= self.upgrade_height:
+            self.logger.info("upgrade height reached; stopping BFT", height=height)
+            self._running = False
+            self.state = new_state
+            if self.on_upgrade is not None:
+                res = self.on_upgrade(new_state)
+                if asyncio.iscoroutine(res):
+                    await res
+            self._notify_height(height)
+            return
+
+        self._update_to_state(new_state)
+        self._notify_height(height)
+        self._schedule_round_0()
+
+    def _notify_height(self, height: int) -> None:
+        ev = self._height_waiters.pop(height, None)
+        if ev is not None:
+            ev.set()
+        for h in list(self._height_waiters):
+            if h <= height:
+                self._height_waiters.pop(h).set()
+
+    def _update_to_state(self, state: State) -> None:
+        """updateToState (reference :622): reset RoundState for the next
+        height."""
+        rs = self.rs
+        last_precommits = None
+        if rs.commit_round > -1 and rs.votes is not None:
+            pc = rs.votes.precommits(rs.commit_round)
+            if pc is not None and pc.has_two_thirds_majority():
+                last_precommits = pc
+        height = (
+            state.initial_height
+            if state.last_block_height == 0
+            else state.last_block_height + 1
+        )
+        self.state = state
+        rs.height = height
+        rs.round = 0
+        rs.step = Step.NEW_HEIGHT
+        # commit_time + timeout_commit (reference: wait for stragglers)
+        base = (
+            self.now_ns()
+            if state.last_block_height == 0
+            else self.now_ns()
+        )
+        rs.start_time_ns = base + int(self.config.timeout_commit * 1e9)
+        if self.config.skip_timeout_commit and last_precommits is not None:
+            rs.start_time_ns = self.now_ns()
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, state.validators)
+        rs.commit_round = -1
+        rs.last_commit = last_precommits
+        rs.triggered_timeout_precommit = False
+        if self.notifier is not None:
+            self.notifier.enable_for_height(height)
+        self._new_step()
+
+    # --- votes ------------------------------------------------------------
+
+    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        try:
+            return await self._add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            # equivocation: turn it into evidence (reference :2274-2330)
+            if self.evpool is not None and self._vote_in_valset(vote):
+                _, val = self.state.validators.get_by_address(
+                    vote.validator_address
+                )
+                ev = DuplicateVoteEvidence.from_votes(
+                    e.existing,
+                    e.new,
+                    self.state.validators.total_voting_power(),
+                    val.voting_power if val else 0,
+                    self.now_ns(),
+                )
+                self.evpool.add_evidence(ev, self.state)
+            self.logger.info(
+                "conflicting vote captured",
+                validator=vote.validator_address.hex()[:12],
+            )
+            return False
+        except ValueError as e:
+            self.logger.info("bad vote", err=repr(e))
+            return False
+
+    def _vote_in_valset(self, vote: Vote) -> bool:
+        return self.state.validators.has_address(vote.validator_address)
+
+    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """addVote (reference :2274-2519)."""
+        rs = self.rs
+        # precommit from the previous height (straggler for LastCommit)
+        if (
+            vote.height + 1 == rs.height
+            and vote.type == VoteType.PRECOMMIT
+            and rs.step == Step.NEW_HEIGHT
+            and rs.last_commit is not None
+        ):
+            added = rs.last_commit.add_vote(
+                vote, verified=self._verify_vote(vote, self.state.last_validators)
+            )
+            return added
+        if vote.height != rs.height:
+            return False
+
+        if not self._verify_vote(vote, self.state.validators):
+            raise ValueError("invalid vote signature")
+
+        # morph: BLS dual-signature on batch-point precommits
+        # (reference :2297-2312, :2362-2379)
+        if (
+            vote.type == VoteType.PRECOMMIT
+            and not vote.is_nil()
+            and self._batch_hash_for_block(vote.block_id.hash)
+        ):
+            batch_hash = self._batch_hash_for_block(vote.block_id.hash)
+            _, val = self.state.validators.get_by_address(
+                vote.validator_address
+            )
+            if not vote.bls_signature:
+                raise ValueError("missing BLS signature at batch point")
+            if not self.l2.verify_signature(
+                val.pub_key.data, batch_hash, vote.bls_signature
+            ):
+                raise ValueError("invalid BLS signature on batch hash")
+            self.l2.append_bls_data(
+                vote.height,
+                batch_hash,
+                BlsData(vote.validator_address, vote.bls_signature),
+            )
+
+        added = rs.votes.add_vote(vote, peer_id, verified=True)
+        if not added:
+            return False
+        self.event_switch.fire_event(EVENT_VOTE, vote)
+        if self.event_bus is not None:
+            await self.event_bus.publish_vote(vote)
+
+        if vote.type == VoteType.PREVOTE:
+            await self._on_prevote_added(vote)
+        else:
+            await self._on_precommit_added(vote)
+        return added
+
+    def _batch_hash_for_block(self, block_hash: bytes) -> bytes:
+        """The batch hash if block_hash is a known batch-point proposal."""
+        rs = self.rs
+        for blk in (rs.proposal_block, rs.locked_block, rs.valid_block):
+            if blk is not None and blk.hash() == block_hash:
+                return blk.header.batch_hash
+        return b""
+
+    def _verify_vote(self, vote: Vote, vals) -> bool:
+        """Signature check through the batch verifier (host fast path for
+        singles; the reactor pre-batches under load)."""
+        val = vals.get_by_index(vote.validator_index)
+        if val is None or val.address != vote.validator_address:
+            return False
+        ok = self.verifier.verify(
+            [
+                SigItem(
+                    val.pub_key.data,
+                    vote.sign_bytes(self.state.chain_id),
+                    vote.signature,
+                )
+            ]
+        )
+        return bool(ok[0])
+
+    async def _on_prevote_added(self, vote: Vote) -> None:
+        """Prevote threshold logic (reference :2398-2476)."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        bid, ok = prevotes.two_thirds_majority()
+        if ok:
+            # unlock on a later polka (reference: "Unlock if prevotes
+            # justify it")
+            if (
+                rs.locked_block is not None
+                and rs.locked_round < vote.round <= rs.round
+                and rs.locked_block.hash() != bid.hash
+            ):
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                if self.event_bus is not None:
+                    await self.event_bus.publish_unlock(rs)
+            # update valid block on polka for the proposal block
+            if (
+                not bid.is_zero()
+                and rs.valid_round < vote.round == rs.round
+            ):
+                if (
+                    rs.proposal_block is not None
+                    and rs.proposal_block.hash() == bid.hash
+                ):
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                elif rs.proposal_block_parts is None or not (
+                    rs.proposal_block_parts.has_header(bid.part_set_header)
+                ):
+                    # polka for a block we don't have: start fetching it
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet(bid.part_set_header)
+                self.event_switch.fire_event(EVENT_VALID_BLOCK, rs)
+                if self.event_bus is not None:
+                    await self.event_bus.publish_polka(rs)
+
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote.round)
+        elif rs.round == vote.round and rs.step >= Step.PREVOTE:
+            if ok and (self._is_proposal_complete() or bid.is_zero()):
+                await self._enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any():
+                await self._enter_prevote_wait(rs.height, vote.round)
+        elif (
+            rs.proposal is not None
+            and 0 <= rs.proposal.pol_round == vote.round
+        ):
+            if self._is_proposal_complete():
+                await self._enter_prevote(rs.height, rs.round)
+
+    async def _on_precommit_added(self, vote: Vote) -> None:
+        """Precommit threshold logic (reference :2478-2516)."""
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        bid, ok = precommits.two_thirds_majority()
+        if ok:
+            await self._enter_new_round(rs.height, vote.round)
+            await self._enter_precommit(rs.height, vote.round)
+            if not bid.is_zero():
+                await self._enter_commit(rs.height, vote.round)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    pass  # commit already finalizes; next height scheduled
+            else:
+                await self._enter_precommit_wait(rs.height, vote.round)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote.round)
+            await self._enter_precommit_wait(rs.height, vote.round)
+
+    # --- signing ----------------------------------------------------------
+
+    async def _sign_add_vote(
+        self, vote_type: int, block_hash: bytes, psh
+    ) -> Optional[Vote]:
+        """signVote + send to our own queue (reference signAddVote :2596)."""
+        if self.priv_validator is None or self._privval_pubkey is None:
+            return None
+        addr = self._privval_pubkey.address()
+        idx, _ = self.state.validators.get_by_address(addr)
+        if idx < 0:
+            return None  # not a validator this height
+        rs = self.rs
+        from ..types.part_set import PartSetHeader
+
+        vote = Vote(
+            type=vote_type,
+            height=rs.height,
+            round=rs.round,
+            block_id=BlockID(
+                block_hash, psh if psh is not None else PartSetHeader()
+            ),
+            timestamp_ns=self.now_ns(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        # morph: BLS dual-sign precommits on batch-point blocks
+        # (reference signVote :2522-2572)
+        if (
+            vote_type == VoteType.PRECOMMIT
+            and block_hash
+            and self.bls_signer is not None
+        ):
+            batch_hash = self._batch_hash_for_block(block_hash)
+            if batch_hash:
+                vote.bls_signature = self.bls_signer(batch_hash)
+        try:
+            res = self.priv_validator.sign_vote(self.state.chain_id, vote)
+            if asyncio.iscoroutine(res):
+                await res
+        except Exception as e:
+            self.logger.error("failed to sign vote", err=repr(e))
+            return None
+        await self.internal_msg_queue.put((VoteMessage(vote), ""))
+        if self.broadcast_hook is not None:
+            self.broadcast_hook(VoteMessage(vote))
+        return vote
+
+
+# --- WAL codec for consensus messages -------------------------------------
+
+from ..libs import protoio as pio
+
+
+def _encode_wal_msg(msg) -> tuple[str, bytes]:
+    from .messages import encode_msg
+
+    return "consensus", encode_msg(msg)
+
+
+def _encode_timeout(ti: TimeoutInfo) -> bytes:
+    return (
+        pio.field_varint(1, int(ti.duration_s * 1e9))
+        + pio.field_varint(2, ti.height)
+        + pio.field_varint(3, ti.round + 1)
+        + pio.field_varint(4, int(ti.step))
+    )

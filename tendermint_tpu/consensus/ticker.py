@@ -1,0 +1,51 @@
+"""Timeout ticker — schedules round-step timeouts into the consensus loop.
+
+Reference: consensus/ticker.go (timeoutTicker :31): one scheduling routine;
+a newer schedule replaces an older one (only the latest timeout can fire).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class TimeoutInfo:
+    duration_s: float
+    height: int
+    round: int
+    step: int  # Step enum value
+
+    def __repr__(self) -> str:
+        return f"TO{{{self.duration_s}s {self.height}/{self.round}/{self.step}}}"
+
+
+class TimeoutTicker:
+    def __init__(self):
+        self._out: asyncio.Queue[TimeoutInfo] = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+
+    @property
+    def tock_queue(self) -> asyncio.Queue:
+        return self._out
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        """Replaces any pending timeout (the reference stops the old timer
+        before starting the new one)."""
+        if self._task is not None:
+            self._task.cancel()
+        self._task = asyncio.get_running_loop().create_task(self._fire(ti))
+
+    async def _fire(self, ti: TimeoutInfo) -> None:
+        try:
+            await asyncio.sleep(ti.duration_s)
+            self._out.put_nowait(ti)
+        except asyncio.CancelledError:
+            pass
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

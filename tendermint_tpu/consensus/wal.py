@@ -1,0 +1,186 @@
+"""Consensus write-ahead log.
+
+Reference: consensus/wal.go — every message is logged BEFORE being acted
+on (TimedWALMessage :35, EndHeightMessage :42, WAL iface :58, BaseWAL :76
+over autofile.Group, CRC+length framed encoder :288-420). fsync happens on
+internal messages (consensus/state.go:821-828) and on EndHeight
+(state.go:1853-1859) so a crashed node replays deterministically
+(replay.go:95-173 catchupReplay).
+
+Record frame: crc32(payload) u32be | len(payload) u32be | payload, where
+payload = field(1)=kind, field(2)=timestamp_ns, field(3)=data.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+import zlib
+from dataclasses import dataclass
+from io import BytesIO
+from typing import Iterator, Optional
+
+from ..libs import protoio as pio
+from ..libs.autofile import Group
+
+MAX_WAL_MSG_SIZE = 1 << 20
+
+KIND_END_HEIGHT = "end_height"
+
+
+@dataclass
+class WALMessage:
+    kind: str  # "end_height" or a consensus message kind
+    data: bytes
+    timestamp_ns: int = 0
+
+
+def encode_record(msg: WALMessage) -> bytes:
+    payload = (
+        pio.field_bytes(1, msg.kind.encode())
+        + pio.field_varint(2, msg.timestamp_ns or time.time_ns())
+        + pio.field_bytes(3, msg.data)
+    )
+    if len(payload) > MAX_WAL_MSG_SIZE:
+        raise ValueError("WAL message too big")
+    return (
+        struct.pack(">I", zlib.crc32(payload))
+        + struct.pack(">I", len(payload))
+        + payload
+    )
+
+
+class WALCorruption(Exception):
+    pass
+
+
+def decode_records(
+    data: bytes, lenient: bool = False
+) -> Iterator[WALMessage]:
+    """Yields messages; raises WALCorruption (or stops, if lenient — the
+    last record of a crashed node is expected to be torn)."""
+    buf = BytesIO(data)
+    total = len(data)
+    while buf.tell() < total:
+        head = buf.read(8)
+        if len(head) < 8:
+            if lenient:
+                return
+            raise WALCorruption("truncated record header")
+        crc, length = struct.unpack(">II", head)
+        if length > MAX_WAL_MSG_SIZE:
+            if lenient:
+                return
+            raise WALCorruption("record length too large")
+        payload = buf.read(length)
+        if len(payload) < length:
+            if lenient:
+                return
+            raise WALCorruption("truncated record payload")
+        if zlib.crc32(payload) != crc:
+            if lenient:
+                return
+            raise WALCorruption("crc mismatch")
+        f = pio.decode_fields(payload)
+        yield WALMessage(
+            kind=f[1][0].decode(),
+            data=f.get(3, [b""])[0],
+            timestamp_ns=f.get(2, [0])[0],
+        )
+
+
+class WAL:
+    """File WAL over an autofile Group (reference BaseWAL)."""
+
+    def __init__(self, path: str, head_size_limit: int = 10 * 1024 * 1024):
+        self._group = Group(path, head_size_limit=head_size_limit)
+        self._path = path
+
+    def write(self, msg: WALMessage) -> None:
+        self._group.write(encode_record(msg))
+
+    def write_sync(self, msg: WALMessage) -> None:
+        self.write(msg)
+        self._group.sync()
+
+    def write_end_height(self, height: int) -> None:
+        """The end-height barrier, fsynced (reference state.go:1853)."""
+        self.write_sync(
+            WALMessage(KIND_END_HEIGHT, pio.write_uvarint(height))
+        )
+
+    def flush_and_sync(self) -> None:
+        self._group.sync()
+
+    def close(self) -> None:
+        self._group.close()
+
+    # --- replay -----------------------------------------------------------
+
+    def search_for_end_height(self, height: int) -> Optional[list[WALMessage]]:
+        """Messages AFTER the end-height record for `height` (i.e. the
+        in-progress height+1 messages to replay). None if no such record.
+        height=0 means replay from the beginning."""
+        msgs = list(decode_records(self._group.read_all(), lenient=True))
+        if height == 0:
+            return msgs
+        for i, m in enumerate(msgs):
+            if m.kind == KIND_END_HEIGHT:
+                h = pio.read_uvarint(BytesIO(m.data))
+                if h == height:
+                    return msgs[i + 1 :]
+        return None
+
+    def repair(self) -> int:
+        """Truncate the head file at the first corrupt record (reference
+        repairWalFile, consensus/state.go:2714). Returns bytes dropped."""
+        self._group.flush()
+        with open(self._path, "rb") as f:
+            data = f.read()
+        good = 0
+        buf = BytesIO(data)
+        while True:
+            head = buf.read(8)
+            if len(head) < 8:
+                break
+            crc, length = struct.unpack(">II", head)
+            if length > MAX_WAL_MSG_SIZE:
+                break
+            payload = buf.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break
+            good = buf.tell()
+        dropped = len(data) - good
+        if dropped:
+            with open(self._path, "rb+") as f:
+                f.truncate(good)
+            # reopen head so the append offset is right
+            self._group._head.close()
+            self._group._head = open(self._path, "ab")
+        return dropped
+
+
+class NilWAL:
+    """No-op WAL for tests (reference consensus/wal.go:421 nilWAL)."""
+
+    def write(self, msg) -> None:
+        pass
+
+    def write_sync(self, msg) -> None:
+        pass
+
+    def write_end_height(self, height: int) -> None:
+        pass
+
+    def flush_and_sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def search_for_end_height(self, height: int):
+        return None
+
+    def repair(self) -> int:
+        return 0

@@ -64,8 +64,13 @@ class BatchVerifier:
     the reduction rides ICI).
     """
 
-    def __init__(self, mesh: Mesh | None = None):
+    def __init__(self, mesh: Mesh | None = None, min_device_batch: int = 8):
+        """min_device_batch: below this size the host CPU verifies serially
+        — a device round-trip costs more than a handful of host verifies
+        (the adaptive micro-batching tradeoff, SURVEY.md §7.3 hard part 3).
+        Set to 0 to force everything onto the device."""
         self._mesh = mesh
+        self._min_device_batch = min_device_batch
         if mesh is None:
             self._fn = jax.jit(ed25519_batch.verify_prehashed)
             self._nshards = 1
@@ -84,6 +89,13 @@ class BatchVerifier:
         n = len(items)
         if n == 0:
             return np.zeros(0, dtype=bool)
+        if n < self._min_device_batch:
+            from . import ed25519 as host
+
+            return np.array(
+                [host.verify(it.pubkey, it.msg, it.sig) for it in items],
+                dtype=bool,
+            )
         b = _bucket(n, multiple_of=self._nshards)
         pub = np.zeros((b, 32), dtype=np.uint8)
         rb = np.zeros((b, 32), dtype=np.uint8)

@@ -1,0 +1,125 @@
+"""MockL2Node — complete in-memory L2 execution node fake.
+
+Reference: l2node/mock.go:22-41 — the full in-mem fake including batch
+encoding and validator-set-update injection, which is what makes the
+consensus net testable without a real execution node.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..libs import protoio as pio
+from .l2node import BlockData, BlsData
+
+
+class MockL2Node:
+    def __init__(
+        self,
+        txs_per_block: int = 2,
+        batch_blocks_interval: int = 0,
+        bls_verifier: Optional[Callable[[bytes, bytes, bytes], bool]] = None,
+    ):
+        self._lock = threading.Lock()
+        self.txs_per_block = txs_per_block
+        self.batch_blocks_interval = batch_blocks_interval
+        self._bls_verifier = bls_verifier
+        # injected pending validator updates: height -> list[(type,pub,power)]
+        self.validator_updates: dict[int, list] = {}
+        # executed chain
+        self.delivered: list[tuple[int, bytes]] = []  # (height, block_hash)
+        # batching state
+        self.open_batch_blocks: list[bytes] = []
+        self.sealed: Optional[tuple[bytes, bytes]] = None  # (hash, header)
+        self.committed_batches: list[tuple[bytes, list[BlsData]]] = []
+        self.bls_appended: list[tuple[int, bytes, BlsData]] = []
+        # externally injectable txs (else deterministic synthetic txs)
+        self.pending_txs: list[bytes] = []
+
+    # --- block production -------------------------------------------------
+
+    def inject_txs(self, txs: list[bytes]) -> None:
+        with self._lock:
+            self.pending_txs.extend(txs)
+
+    def has_txs(self) -> bool:
+        return True  # synthetic txs are always available
+
+    def request_block_data(self, height: int) -> BlockData:
+        with self._lock:
+            if self.pending_txs:
+                txs, self.pending_txs = self.pending_txs, []
+            else:
+                txs = [
+                    b"tx-%d-%d=v%d" % (height, i, i)
+                    for i in range(self.txs_per_block)
+                ]
+            meta = b"l2meta:" + pio.write_uvarint(height)
+            return BlockData(txs=txs, l2_block_meta=meta)
+
+    def check_block_data(self, txs: list[bytes], l2_block_meta: bytes) -> bool:
+        return l2_block_meta.startswith(b"l2meta:")
+
+    def deliver_block(self, height, block_hash, txs, l2_block_meta):
+        with self._lock:
+            self.delivered.append((height, block_hash))
+            updates = self.validator_updates.pop(height, [])
+            return updates, None
+
+    def encode_txs(self, txs: list[bytes]) -> bytes:
+        return b"".join(pio.field_bytes(1, tx) for tx in txs)
+
+    def request_height(self, tm_height: int) -> int:
+        return tm_height
+
+    # --- BLS --------------------------------------------------------------
+
+    def verify_signature(self, tm_pubkey, message_hash, signature) -> bool:
+        if self._bls_verifier is not None:
+            return self._bls_verifier(tm_pubkey, message_hash, signature)
+        return True  # BLS disabled in this mock configuration
+
+    def append_bls_data(self, height, batch_hash, data: BlsData) -> None:
+        with self._lock:
+            self.bls_appended.append((height, batch_hash, data))
+
+    # --- batching ---------------------------------------------------------
+
+    def calculate_batch_size_with_proposal_block(
+        self, proposal_block_bytes: bytes, get_from_cache: bool
+    ) -> bool:
+        if self.batch_blocks_interval <= 0:
+            return False
+        with self._lock:
+            return (
+                len(self.open_batch_blocks) + 1 >= self.batch_blocks_interval
+            )
+
+    def seal_batch(self) -> tuple[bytes, bytes]:
+        with self._lock:
+            header = b"batch:" + pio.write_uvarint(
+                len(self.open_batch_blocks)
+            ) + b"".join(
+                hashlib.sha256(b).digest() for b in self.open_batch_blocks
+            )
+            h = hashlib.sha256(header).digest()
+            self.sealed = (h, header)
+            return h, header
+
+    def commit_batch(self, current_block_bytes, bls_datas) -> None:
+        with self._lock:
+            if self.sealed is None:
+                raise RuntimeError("commit_batch without seal_batch")
+            self.committed_batches.append((self.sealed[0], list(bls_datas)))
+            self.sealed = None
+            self.open_batch_blocks = [current_block_bytes]
+
+    def pack_current_block(self, current_block_bytes) -> None:
+        with self._lock:
+            self.open_batch_blocks.append(current_block_bytes)
+
+    def batch_hash(self, batch_header: bytes) -> bytes:
+        return hashlib.sha256(batch_header).digest()

@@ -1,0 +1,209 @@
+"""MConnection — one TCP link multiplexed into prioritized byte channels.
+
+Reference: p2p/conn/connection.go:78-210 (MConnection, ChannelDescriptor
+:721, sendRoutine :422 / recvRoutine :560): messages are chopped into
+~1024-byte packets tagged with a channel id + EOF flag; the send routine
+picks the channel with the least recently-used-relative-to-priority queue;
+ping/pong keepalive rides channel 0xFF here (the reference uses dedicated
+packet types).
+
+Packet layout inside a SecretConnection message:
+  byte 0: channel id (0xFE ping, 0xFF pong)
+  byte 1: eof flag
+  bytes 2..: payload chunk
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from ..libs.log import Logger, nop_logger
+
+MAX_PACKET_PAYLOAD = 1000
+_PING = 0xFE
+_PONG = 0xFF
+
+
+@dataclass
+class ChannelDescriptor:
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 100
+    recv_message_capacity: int = 1 << 22
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.send_queue: asyncio.Queue[bytes] = asyncio.Queue(
+            desc.send_queue_capacity
+        )
+        self.sending: bytes = b""
+        self.recv_buf = bytearray()
+        self.recently_sent = 0  # decayed bytes for priority scheduling
+
+    def is_send_pending(self) -> bool:
+        return bool(self.sending) or not self.send_queue.empty()
+
+    def next_packet(self) -> tuple[bytes, bool]:
+        if not self.sending:
+            self.sending = self.send_queue.get_nowait()
+        chunk = self.sending[:MAX_PACKET_PAYLOAD]
+        self.sending = self.sending[MAX_PACKET_PAYLOAD:]
+        eof = not self.sending
+        self.recently_sent += len(chunk)
+        return chunk, eof
+
+
+class MConnection:
+    """on_receive(channel_id, message_bytes) is awaited per complete
+    message; on_error(err) fires once when the connection dies."""
+
+    def __init__(
+        self,
+        conn,  # SecretConnection (or anything with read/write/close)
+        channels: list[ChannelDescriptor],
+        on_receive: Callable[[int, bytes], Awaitable[None]],
+        on_error: Optional[Callable[[Exception], Awaitable[None]]] = None,
+        ping_interval: float = 10.0,
+        logger: Optional[Logger] = None,
+    ):
+        self._conn = conn
+        self._channels = {d.id: _Channel(d) for d in channels}
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self._ping_interval = ping_interval
+        self.logger = logger or nop_logger()
+        self._tasks: list[asyncio.Task] = []
+        self._send_signal = asyncio.Event()
+        self._running = False
+        self._errored = False
+
+    def start(self) -> None:
+        self._running = True
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._send_routine()),
+            loop.create_task(self._recv_routine()),
+            loop.create_task(self._ping_routine()),
+        ]
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._conn.close()
+
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        """Queue a message; False if the channel queue is full (TrySend)."""
+        ch = self._channels.get(channel_id)
+        if ch is None or not self._running:
+            return False
+        try:
+            ch.send_queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            return False
+        self._send_signal.set()
+        return True
+
+    async def _send_routine(self) -> None:
+        try:
+            while self._running:
+                await self._send_signal.wait()
+                sent_any = True
+                while sent_any:
+                    sent_any = await self._send_some()
+                self._send_signal.clear()
+                # re-check: a send() between loop exit and clear would be
+                # lost without this
+                if any(
+                    c.is_send_pending() for c in self._channels.values()
+                ):
+                    self._send_signal.set()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            await self._die(e)
+
+    async def _send_some(self) -> bool:
+        """Send one packet from the least-loaded-by-priority channel
+        (reference sendSomePacketMsgs)."""
+        best = None
+        best_ratio = None
+        for ch in self._channels.values():
+            if not ch.is_send_pending():
+                continue
+            ratio = ch.recently_sent / max(1, ch.desc.priority)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        if best is None:
+            return False
+        chunk, eof = best.next_packet()
+        pkt = bytes([best.desc.id, 1 if eof else 0]) + chunk
+        await self._conn.write(pkt)
+        # decay counters so priorities stay relative
+        for ch in self._channels.values():
+            ch.recently_sent = int(ch.recently_sent * 0.8)
+        return True
+
+    async def _recv_routine(self) -> None:
+        try:
+            while self._running:
+                pkt = await self._read_packet()
+                if pkt is None:
+                    continue
+                ch_id, eof, chunk = pkt
+                if ch_id == _PING:
+                    await self._conn.write(bytes([_PONG, 1]))
+                    continue
+                if ch_id == _PONG:
+                    continue
+                ch = self._channels.get(ch_id)
+                if ch is None:
+                    raise ValueError(f"unknown channel {ch_id:#x}")
+                ch.recv_buf += chunk
+                if len(ch.recv_buf) > ch.desc.recv_message_capacity:
+                    raise ValueError("message exceeds recv capacity")
+                if eof:
+                    msg = bytes(ch.recv_buf)
+                    ch.recv_buf = bytearray()
+                    await self._on_receive(ch_id, msg)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            await self._die(e)
+
+    async def _read_packet(self):
+        # one SecretConnection frame carries exactly one packet (we always
+        # write packets as single frames ≤ 1024B)
+        data = await self._conn.read()
+        if len(data) < 2:
+            return None
+        return data[0], data[1] == 1, data[2:]
+
+    async def _ping_routine(self) -> None:
+        try:
+            while self._running:
+                await asyncio.sleep(self._ping_interval)
+                await self._conn.write(bytes([_PING, 1]))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            await self._die(e)
+
+    async def _die(self, err: Exception) -> None:
+        if self._errored or not self._running:
+            return
+        self._errored = True
+        self._running = False
+        self.logger.info("connection error", err=repr(err))
+        if self._on_error is not None:
+            await self._on_error(err)

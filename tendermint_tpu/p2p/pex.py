@@ -1,0 +1,241 @@
+"""PEX — peer exchange + persistent address book.
+
+Reference: p2p/pex/ (addrbook.go with old/new buckets, pex_reactor.go,
+seed-mode crawl). The address book here keeps the same observable behavior
+— persistent JSON, markGood/markAttempt, pick for dialing — with a single
+scored table instead of the reference's 256+64 hashed buckets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..libs import protoio as pio
+from ..libs.log import nop_logger
+from .mconn import ChannelDescriptor
+from .switch import Reactor
+from .transport import NetAddress, Peer
+
+PEX_CHANNEL = 0x00
+
+
+@dataclass
+class KnownAddress:
+    addr: str  # "id@host:port"
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket: str = "new"  # "new" | "old" (old = proven good)
+
+
+class AddrBook:
+    def __init__(self, path: str = "", our_id: str = ""):
+        self._path = path
+        self._our_id = our_id
+        self._addrs: dict[str, KnownAddress] = {}  # node id -> entry
+        if path and os.path.exists(path):
+            self._load()
+
+    def add_address(self, addr: NetAddress) -> bool:
+        if not addr.id or addr.id == self._our_id:
+            return False
+        if addr.id in self._addrs:
+            return False
+        self._addrs[addr.id] = KnownAddress(addr=str(addr))
+        return True
+
+    def mark_attempt(self, node_id: str) -> None:
+        ka = self._addrs.get(node_id)
+        if ka:
+            ka.attempts += 1
+            ka.last_attempt = time.time()
+
+    def mark_good(self, node_id: str) -> None:
+        ka = self._addrs.get(node_id)
+        if ka:
+            ka.attempts = 0
+            ka.last_success = time.time()
+            ka.bucket = "old"
+
+    def remove_address(self, node_id: str) -> None:
+        self._addrs.pop(node_id, None)
+
+    def pick_address(self, exclude: set[str]) -> Optional[NetAddress]:
+        """Biased pick: prefer old (proven) addresses, avoid many-failures."""
+        candidates = [
+            ka
+            for nid, ka in self._addrs.items()
+            if nid not in exclude and ka.attempts < 10
+        ]
+        if not candidates:
+            return None
+        old = [ka for ka in candidates if ka.bucket == "old"]
+        pool = old if old and secrets.randbelow(100) < 70 else candidates
+        return NetAddress.parse(pool[secrets.randbelow(len(pool))].addr)
+
+    def get_selection(self, max_n: int = 30) -> list[NetAddress]:
+        addrs = [NetAddress.parse(ka.addr) for ka in self._addrs.values()]
+        secrets.SystemRandom().shuffle(addrs)
+        return addrs[:max_n]
+
+    def size(self) -> int:
+        return len(self._addrs)
+
+    def save(self) -> None:
+        if not self._path:
+            return
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        with open(self._path, "w") as f:
+            json.dump(
+                {
+                    nid: {
+                        "addr": ka.addr,
+                        "attempts": ka.attempts,
+                        "bucket": ka.bucket,
+                        "last_success": ka.last_success,
+                    }
+                    for nid, ka in self._addrs.items()
+                },
+                f,
+                indent=2,
+            )
+
+    def _load(self) -> None:
+        with open(self._path) as f:
+            data = json.load(f)
+        for nid, d in data.items():
+            self._addrs[nid] = KnownAddress(
+                addr=d["addr"],
+                attempts=d.get("attempts", 0),
+                bucket=d.get("bucket", "new"),
+                last_success=d.get("last_success", 0.0),
+            )
+
+
+# --- pex reactor ----------------------------------------------------------
+
+_MSG_REQUEST = 1
+_MSG_ADDRS = 2
+
+
+def _encode_addrs(addrs: list[NetAddress]) -> bytes:
+    return pio.field_varint(1, _MSG_ADDRS) + b"".join(
+        pio.field_bytes(2, str(a).encode()) for a in addrs
+    )
+
+
+def _encode_request() -> bytes:
+    return pio.field_varint(1, _MSG_REQUEST)
+
+
+class PEXReactor(Reactor):
+    """Requests addresses from peers, serves its own, and keeps dialing
+    until enough outbound connections exist (reference pex_reactor.go).
+    seed_mode: accept, exchange addresses, disconnect (crawler)."""
+
+    def __init__(
+        self,
+        book: AddrBook,
+        target_outbound: int = 10,
+        seed_mode: bool = False,
+        logger=None,
+    ):
+        super().__init__("pex")
+        self.book = book
+        self.target_outbound = target_outbound
+        self.seed_mode = seed_mode
+        self.logger = logger or nop_logger()
+        self._requested: set[str] = set()
+        self._ensure_task: Optional[asyncio.Task] = None
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1)]
+
+    async def on_start(self) -> None:
+        self._ensure_task = asyncio.get_running_loop().create_task(
+            self._ensure_peers_routine()
+        )
+
+    async def on_stop(self) -> None:
+        if self._ensure_task:
+            self._ensure_task.cancel()
+        self.book.save()
+
+    async def add_peer(self, peer: Peer) -> None:
+        # inbound peers' self-reported listen addr goes into the book
+        if peer.node_info.listen_addr:
+            try:
+                addr = NetAddress.parse(
+                    f"{peer.id}@{peer.node_info.listen_addr}"
+                )
+                self.book.add_address(addr)
+            except ValueError:
+                pass
+        if peer.outbound:
+            self.book.mark_good(peer.id)
+        elif peer.id not in self._requested:
+            self._requested.add(peer.id)
+            peer.send(PEX_CHANNEL, _encode_request())
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        self._requested.discard(peer.id)
+
+    async def receive(self, channel_id: int, peer: Peer, msg: bytes) -> None:
+        f = pio.decode_fields(msg)
+        kind = f.get(1, [0])[0]
+        if kind == _MSG_REQUEST:
+            peer.send(
+                PEX_CHANNEL, _encode_addrs(self.book.get_selection())
+            )
+            if self.seed_mode and not peer.outbound:
+                # seeds disconnect after serving addresses
+                await asyncio.sleep(0.1)
+                await self.switch.stop_peer_gracefully(peer)
+        elif kind == _MSG_ADDRS:
+            for raw in f.get(2, []):
+                try:
+                    self.book.add_address(NetAddress.parse(raw.decode()))
+                except (ValueError, UnicodeDecodeError):
+                    await self.switch.stop_peer_for_error(
+                        peer, "malformed pex address"
+                    )
+                    return
+
+    async def _ensure_peers_routine(self) -> None:
+        while True:
+            try:
+                await self._ensure_peers()
+            except Exception as e:
+                self.logger.info("ensure peers failed", err=repr(e))
+            await asyncio.sleep(1.0)
+
+    async def _ensure_peers(self) -> None:
+        sw = self.switch
+        if sw is None or not sw.is_running:
+            return
+        out = sum(1 for p in sw.peers.values() if p.outbound)
+        if out >= self.target_outbound:
+            return
+        exclude = set(sw.peers.keys()) | sw.dialing | {self.book._our_id}
+        addr = self.book.pick_address(exclude)
+        if addr is None:
+            # ask a random peer for more addresses
+            peers = list(sw.peers.values())
+            if peers:
+                peers[secrets.randbelow(len(peers))].send(
+                    PEX_CHANNEL, _encode_request()
+                )
+            return
+        self.book.mark_attempt(addr.id)
+        try:
+            peer = await sw.dial_peer(addr)
+            if peer is not None:
+                self.book.mark_good(addr.id)
+        except Exception:
+            pass

@@ -1,0 +1,196 @@
+"""Switch — peer lifecycle + reactor multiplexing.
+
+Reference: p2p/switch.go:69 (Switch), p2p/base_reactor.go (Reactor iface).
+Reactors register channel descriptors; the switch owns peers and routes
+each received message to the reactor that claimed its channel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..libs.log import Logger, nop_logger
+from ..libs.service import Service
+from .mconn import ChannelDescriptor, MConnection
+from .node_info import NodeInfo
+from .transport import MultiplexTransport, NetAddress, Peer
+
+
+class Reactor:
+    """Base reactor (reference p2p/base_reactor.go BaseReactor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.switch: Optional["Switch"] = None
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return []
+
+    async def add_peer(self, peer: Peer) -> None:
+        pass
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        pass
+
+    async def receive(self, channel_id: int, peer: Peer, msg: bytes) -> None:
+        pass
+
+    async def on_start(self) -> None:
+        pass
+
+    async def on_stop(self) -> None:
+        pass
+
+
+class Switch(Service):
+    def __init__(
+        self,
+        transport: MultiplexTransport,
+        logger: Optional[Logger] = None,
+        max_peers: int = 50,
+    ):
+        super().__init__("p2p-switch", logger)
+        self.transport = transport
+        self.reactors: dict[str, Reactor] = {}
+        self._channel_to_reactor: dict[int, Reactor] = {}
+        self.peers: dict[str, Peer] = {}
+        self.max_peers = max_peers
+        self.dialing: set[str] = set()
+        self._persistent_addrs: list[NetAddress] = []
+
+    def add_reactor(self, name: str, reactor: Reactor) -> None:
+        for ch in reactor.get_channels():
+            if ch.id in self._channel_to_reactor:
+                raise ValueError(f"channel {ch.id:#x} already claimed")
+            self._channel_to_reactor[ch.id] = reactor
+        reactor.switch = self
+        self.reactors[name] = reactor
+
+    def channels(self) -> bytes:
+        return bytes(sorted(self._channel_to_reactor.keys()))
+
+    # --- lifecycle --------------------------------------------------------
+
+    async def on_start(self) -> None:
+        for r in self.reactors.values():
+            await r.on_start()
+        self.spawn(self._accept_routine(), "accept")
+
+    async def on_stop(self) -> None:
+        for peer in list(self.peers.values()):
+            await self._stop_and_remove(peer, "switch stopping")
+        for r in self.reactors.values():
+            await r.on_stop()
+        await self.transport.close()
+
+    async def _accept_routine(self) -> None:
+        while True:
+            info, sconn, addr = await self.transport.accept()
+            if len(self.peers) >= self.max_peers:
+                sconn.close()
+                continue
+            try:
+                await self._add_peer(info, sconn, addr, outbound=False)
+            except Exception as e:
+                self.logger.info("failed to add inbound peer", err=repr(e))
+                sconn.close()
+
+    # --- dialing ----------------------------------------------------------
+
+    async def dial_peer(self, addr: NetAddress) -> Optional[Peer]:
+        if addr.id and (addr.id in self.peers or addr.id in self.dialing):
+            return None
+        self.dialing.add(addr.id)
+        try:
+            info, sconn, addr = await self.transport.dial(addr)
+            return await self._add_peer(info, sconn, addr, outbound=True)
+        finally:
+            self.dialing.discard(addr.id)
+
+    def dial_peers_async(self, addrs: list[NetAddress], persistent: bool = True) -> None:
+        if persistent:
+            self._persistent_addrs.extend(addrs)
+        for addr in addrs:
+            self.spawn(self._dial_with_retry(addr), f"dial/{addr}")
+
+    async def _dial_with_retry(self, addr: NetAddress) -> None:
+        backoff = 0.2
+        while self.is_running:
+            try:
+                peer = await self.dial_peer(addr)
+                if peer is not None or (addr.id and addr.id in self.peers):
+                    return
+            except Exception as e:
+                self.logger.info("dial failed", addr=str(addr), err=repr(e))
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 10.0)
+
+    # --- peers ------------------------------------------------------------
+
+    async def _add_peer(
+        self, info: NodeInfo, sconn, addr: NetAddress, outbound: bool
+    ) -> Peer:
+        my_info = self.transport._node_info_fn()
+        my_info.compatible_with(info)
+        if info.node_id == my_info.node_id:
+            sconn.close()
+            raise ValueError("connected to self")
+        if info.node_id in self.peers:
+            sconn.close()
+            raise ValueError("duplicate peer")
+
+        descs = [
+            d
+            for r in self.reactors.values()
+            for d in r.get_channels()
+        ]
+        peer_holder: list[Peer] = []
+
+        async def on_receive(ch_id: int, msg: bytes) -> None:
+            reactor = self._channel_to_reactor.get(ch_id)
+            if reactor is not None and peer_holder:
+                await reactor.receive(ch_id, peer_holder[0], msg)
+
+        async def on_error(err: Exception) -> None:
+            if peer_holder:
+                await self.stop_peer_for_error(peer_holder[0], repr(err))
+
+        mconn = MConnection(sconn, descs, on_receive, on_error)
+        peer = Peer(info, sconn, mconn, outbound, addr)
+        peer_holder.append(peer)
+        self.peers[peer.id] = peer
+        mconn.start()
+        for r in self.reactors.values():
+            await r.add_peer(peer)
+        self.logger.info("added peer", peer=str(peer))
+        return peer
+
+    async def stop_peer_for_error(self, peer: Peer, reason: str) -> None:
+        """StopPeerForError (reference :opped by every reactor on bad
+        messages); persistent peers get redialed."""
+        if peer.id not in self.peers:
+            return
+        self.logger.info("stopping peer", peer=str(peer), reason=reason)
+        await self._stop_and_remove(peer, reason)
+        for addr in self._persistent_addrs:
+            if addr.id == peer.id and self.is_running:
+                self.spawn(self._dial_with_retry(addr), f"redial/{addr}")
+                break
+
+    async def stop_peer_gracefully(self, peer: Peer) -> None:
+        await self._stop_and_remove(peer, "graceful stop")
+
+    async def _stop_and_remove(self, peer: Peer, reason: str) -> None:
+        self.peers.pop(peer.id, None)
+        await peer.stop()
+        for r in self.reactors.values():
+            await r.remove_peer(peer, reason)
+
+    def broadcast(self, channel_id: int, msg: bytes) -> None:
+        """Best-effort send to every peer (reference Switch.Broadcast :264)."""
+        for peer in list(self.peers.values()):
+            peer.send(channel_id, msg)
+
+    def num_peers(self) -> int:
+        return len(self.peers)

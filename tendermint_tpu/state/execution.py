@@ -1,0 +1,315 @@
+"""BlockExecutor — proposal creation and the ApplyBlock pipeline.
+
+Reference: state/execution.go — CreateProposalBlock :107 (txs pulled from
+the L2 node via the notifier; no mempool), ProcessProposal/ValidateBlock
+:179/:207, ApplyBlock :220-288 (validate → ABCI exec → ExecBlockOnL2Node
+:390-429 → updateState :590 → ABCI Commit :363 → evidence update → save),
+and the L2-driven validator-set diffing :309-360.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..abci import types as abci
+from ..crypto import merkle
+from ..l2node.l2node import BlockData, BlsData, L2Node
+from ..libs import fail
+from ..libs.log import Logger, nop_logger
+from ..store.block_store import BlockStore
+from ..types.block import Block, BlockIDFlag, Commit, Data, Header
+from ..types.block_id import BlockID
+from ..types.evidence import evidence_hash
+from ..types.validator import Validator, pubkey_from_type
+from .state import State
+from .store import StateStore
+
+
+@dataclass
+class ABCIResponses:
+    """Per-height execution results (reference state/execution.go
+    ABCIResponses): deliver_tx results feed last_results_hash."""
+
+    deliver_txs: list[abci.ResponseDeliverTx] = field(default_factory=list)
+    end_block: Optional[abci.ResponseEndBlock] = None
+    begin_block: Optional[abci.ResponseBeginBlock] = None
+
+    def results_hash(self) -> bytes:
+        leaves = [
+            bytes([r.code & 0xFF]) + r.data for r in self.deliver_txs
+        ]
+        return merkle.hash_from_byte_slices(leaves)
+
+    def encode(self) -> bytes:
+        return json.dumps(
+            {
+                "deliver_txs": [
+                    {"code": r.code, "data": r.data.hex(), "log": r.log}
+                    for r in self.deliver_txs
+                ],
+            }
+        ).encode()
+
+
+class BlockExecutor:
+    def __init__(
+        self,
+        state_store: StateStore,
+        block_store: BlockStore,
+        proxy_app_consensus,  # abci client (consensus connection)
+        l2_node: L2Node,
+        event_bus=None,
+        evidence_pool=None,
+        logger: Optional[Logger] = None,
+    ):
+        self._state_store = state_store
+        self._block_store = block_store
+        self._app = proxy_app_consensus
+        self._l2 = l2_node
+        self._event_bus = event_bus
+        self._evpool = evidence_pool
+        self.logger = logger or nop_logger()
+
+    # --- proposal ---------------------------------------------------------
+
+    def create_proposal_block(
+        self,
+        height: int,
+        state: State,
+        last_commit: Commit | None,
+        proposer_address: bytes,
+        block_data: BlockData,
+        time_ns: int,
+    ) -> Block:
+        """Builds the proposal from L2-provided block data
+        (reference CreateProposalBlock :107)."""
+        evidence = (
+            self._evpool.pending_evidence(
+                state.consensus_params.evidence.max_bytes
+            )
+            if self._evpool
+            else []
+        )
+        header = Header(
+            chain_id=state.chain_id,
+            height=height,
+            time_ns=time_ns,
+            last_block_id=state.last_block_id,
+            validators_hash=state.validators.hash(),
+            next_validators_hash=state.next_validators.hash(),
+            consensus_hash=state.consensus_params.hash(),
+            app_hash=state.app_hash,
+            last_results_hash=state.last_results_hash,
+            proposer_address=proposer_address,
+        )
+        block = Block(
+            header=header,
+            data=Data(
+                txs=list(block_data.txs),
+                l2_block_meta=block_data.l2_block_meta,
+                l2_batch_header=block_data.l2_batch_header,
+            ),
+            evidence=evidence,
+            last_commit=last_commit,
+        )
+        block.fill_header()
+        return block
+
+    # --- validation -------------------------------------------------------
+
+    def validate_block(self, state: State, block: Block) -> None:
+        """Stateful validation incl. evidence (reference ValidateBlock :207)."""
+        state.make_block_validate(block)
+        if self._evpool:
+            for ev in block.evidence:
+                self._evpool.check_evidence(ev, state)
+
+    def process_proposal(self, state: State, block: Block) -> bool:
+        """CheckBlockData against the L2 node (reference ProcessProposal
+        :179 → l2.CheckBlockData — the prevote gate)."""
+        return self._l2.check_block_data(
+            block.data.txs, block.data.l2_block_meta
+        )
+
+    # --- apply ------------------------------------------------------------
+
+    async def apply_block(
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        bls_datas: Optional[list[BlsData]] = None,
+    ) -> State:
+        """The commit pipeline (reference ApplyBlock :220-288)."""
+        self.validate_block(state, block)
+
+        abci_responses = await self._exec_block_on_app(state, block)
+        fail.fail_point()  # crash between app exec and L2 delivery
+
+        val_updates = self._exec_block_on_l2(block, bls_datas or [])
+        fail.fail_point()  # crash between L2 delivery and state update
+
+        # merge validator updates: L2-driven (morph) takes precedence,
+        # else the app's end_block updates (upstream behavior)
+        if not val_updates and abci_responses.end_block is not None:
+            val_updates = [
+                (u.pub_key_type, u.pub_key_data, u.power)
+                for u in abci_responses.end_block.validator_updates
+            ]
+
+        new_state = self._update_state(
+            state, block_id, block, abci_responses, val_updates
+        )
+
+        # ABCI Commit → app hash for the NEXT block
+        res = await self._app.commit()
+        fail.fail_point()  # crash after app commit, before state save
+        new_state.app_hash = res.data
+
+        self._state_store.save_abci_responses(
+            block.header.height, abci_responses.encode()
+        )
+        self._state_store.save(new_state)
+        fail.fail_point()  # crash after state save
+
+        if self._evpool:
+            self._evpool.update(new_state, block.evidence)
+        if res.retain_height > 0:
+            try:
+                self._block_store.prune_blocks(res.retain_height)
+                self._state_store.prune_states(res.retain_height)
+            except ValueError:
+                pass
+
+        if self._event_bus is not None:
+            await self._event_bus.publish_new_block(block)
+            await self._event_bus.publish_new_block_header(block.header)
+            for i, tx in enumerate(block.data.txs):
+                from ..crypto import tmhash
+
+                r = abci_responses.deliver_txs[i]
+                await self._event_bus.publish_tx(
+                    block.header.height,
+                    tmhash.sum(tx),
+                    tx,
+                    {
+                        f"{e.type}.{k}": [v]
+                        for e in r.events
+                        for k, v in e.attributes.items()
+                    },
+                )
+        return new_state
+
+    async def _exec_block_on_app(
+        self, state: State, block: Block
+    ) -> ABCIResponses:
+        last_commit_info = self._make_last_commit_info(state, block)
+        byz = [
+            {"height": ev.height(), "type": type(ev).__name__}
+            for ev in block.evidence
+        ]
+        responses = ABCIResponses()
+        responses.begin_block = await self._app.begin_block(
+            block.header, last_commit_info, byz
+        )
+        for tx in block.data.txs:
+            responses.deliver_txs.append(await self._app.deliver_tx(tx))
+        responses.end_block = await self._app.end_block(block.header.height)
+        return responses
+
+    def _make_last_commit_info(self, state: State, block: Block):
+        if block.last_commit is None or block.header.height == state.initial_height:
+            return {"round": 0, "votes": []}
+        # the signers are the validators of height-1 — during handshake
+        # replay that is NOT state.last_validators (the handshake-time
+        # set), so prefer the height-indexed store record
+        vals = self._state_store.load_validators(block.header.height - 1)
+        if vals is None:
+            vals = state.last_validators
+        votes = []
+        for i, cs in enumerate(block.last_commit.signatures):
+            val = vals.get_by_index(i) if vals else None
+            if val is None:
+                continue
+            votes.append(
+                {
+                    "address": val.address,
+                    "power": val.voting_power,
+                    "signed_last_block": not cs.is_absent(),
+                }
+            )
+        return {"round": block.last_commit.round, "votes": votes}
+
+    def _exec_block_on_l2(
+        self, block: Block, bls_datas: list[BlsData]
+    ) -> list:
+        """DeliverBlock + CommitBatch/PackCurrentBlock
+        (reference ExecBlockOnL2Node :390-429)."""
+        val_updates, _param_updates = self._l2.deliver_block(
+            block.header.height,
+            block.hash(),
+            block.data.txs,
+            block.data.l2_block_meta,
+        )
+        block_bytes = block.encode()
+        if block.header.batch_hash:
+            self._l2.commit_batch(block_bytes, bls_datas)
+        else:
+            self._l2.pack_current_block(block_bytes)
+        return val_updates or []
+
+    def _update_state(
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        abci_responses: ABCIResponses,
+        val_updates: list,
+    ) -> State:
+        """Builds the next State value (reference updateState :590)."""
+        next_validators = state.next_validators.copy()
+        last_height_vals_changed = state.last_height_validators_changed
+        if val_updates:
+            changes = [
+                Validator(pubkey_from_type(t, data), power)
+                for (t, data, power) in val_updates
+            ]
+            next_validators.update_with_change_set(changes)
+            last_height_vals_changed = block.header.height + 1 + 1
+
+        params = state.consensus_params
+        last_height_params_changed = state.last_height_consensus_params_changed
+        if (
+            abci_responses.end_block is not None
+            and abci_responses.end_block.consensus_param_updates
+        ):
+            params = params.update(
+                abci_responses.end_block.consensus_param_updates
+            )
+            last_height_params_changed = block.header.height + 1
+
+        next_validators.increment_proposer_priority(1)
+        return State(
+            chain_id=state.chain_id,
+            initial_height=state.initial_height,
+            last_block_height=block.header.height,
+            last_block_id=block_id,
+            last_block_time_ns=block.header.time_ns,
+            validators=state.next_validators.copy(),
+            next_validators=next_validators,
+            last_validators=state.validators.copy(),
+            last_height_validators_changed=last_height_vals_changed,
+            consensus_params=params,
+            last_height_consensus_params_changed=last_height_params_changed,
+            last_results_hash=abci_responses.results_hash(),
+            app_hash=state.app_hash,  # replaced after ABCI Commit
+        )
+
+    async def exec_commit_block(self, state: State, block: Block) -> bytes:
+        """Replay helper: execute a stored block against the app without
+        state bookkeeping (reference ExecCommitBlock :715)."""
+        await self._exec_block_on_app(state, block)
+        res = await self._app.commit()
+        return res.data

@@ -1,0 +1,198 @@
+"""BlockStore — the durable chain: blocks as parts + metas + commits.
+
+Reference: store/store.go:33-546 (SaveBlock :446, LoadBlock :93,
+PruneBlocks :268, PruneBlocksSince :346). Layout mirrors the reference's
+key scheme: per-height meta, per-(height,part) part payloads, commits and
+seen-commits, plus a base/height range record.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Optional
+
+from ..libs import protoio as pio
+from ..types.block import Block, Commit
+from ..types.block_id import BlockID
+from ..types.block_meta import BlockMeta
+from ..types.part_set import Part, PartSet
+from .kv import KV
+
+
+def _h(prefix: bytes, height: int, extra: int = -1) -> bytes:
+    key = prefix + struct.pack(">q", height)
+    if extra >= 0:
+        key += struct.pack(">i", extra)
+    return key
+
+
+_META = b"H:"
+_PART = b"P:"
+_COMMIT = b"C:"
+_SEEN = b"SC:"
+_STATE = b"BSS"  # block store state: base/height
+
+
+class BlockStore:
+    def __init__(self, db: KV):
+        self._db = db
+        self._mtx = threading.Lock()
+        raw = db.get(_STATE)
+        if raw:
+            f = pio.decode_fields(raw)
+            self._base = f.get(1, [0])[0]
+            self._height = f.get(2, [0])[0]
+        else:
+            self._base = 0
+            self._height = 0
+
+    # --- range ------------------------------------------------------------
+
+    @property
+    def base(self) -> int:
+        with self._mtx:
+            return self._base
+
+    @property
+    def height(self) -> int:
+        with self._mtx:
+            return self._height
+
+    def size(self) -> int:
+        with self._mtx:
+            return self._height - self._base + 1 if self._height > 0 else 0
+
+    def _save_state(self) -> None:
+        self._db.set(
+            _STATE,
+            pio.field_varint(1, self._base) + pio.field_varint(2, self._height),
+        )
+
+    # --- writes -----------------------------------------------------------
+
+    def save_block(
+        self, block: Block, part_set: PartSet, seen_commit: Commit
+    ) -> None:
+        """SaveBlock (reference store/store.go:446): persists the block's
+        parts, meta, its LastCommit (for height-1) and the seen commit."""
+        height = block.header.height
+        with self._mtx:
+            if self._height > 0 and height != self._height + 1:
+                raise ValueError(
+                    f"cannot save block at height {height}, "
+                    f"store is at {self._height}"
+                )
+            sets: list[tuple[bytes, bytes]] = []
+            meta = BlockMeta.from_block(block, part_set)
+            sets.append((_h(_META, height), meta.encode()))
+            for i in range(part_set.total):
+                part = part_set.get_part(i)
+                sets.append((_h(_PART, height, i), part.encode()))
+            if block.last_commit is not None:
+                sets.append(
+                    (_h(_COMMIT, height - 1), block.last_commit.encode())
+                )
+            sets.append((_h(_SEEN, height), seen_commit.encode()))
+            self._db.write_batch(sets, [])
+            if self._base == 0:
+                self._base = height
+            self._height = height
+            self._save_state()
+
+    def save_seen_commit(self, height: int, seen_commit: Commit) -> None:
+        self._db.set(_h(_SEEN, height), seen_commit.encode())
+
+    # --- reads ------------------------------------------------------------
+
+    def load_block_meta(self, height: int) -> Optional[BlockMeta]:
+        raw = self._db.get(_h(_META, height))
+        return BlockMeta.decode(raw) if raw else None
+
+    def load_block(self, height: int) -> Optional[Block]:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        ps = PartSet(meta.block_id.part_set_header)
+        for i in range(ps.total):
+            part = self.load_block_part(height, i)
+            if part is None:
+                return None
+            ps.add_part(part)
+        return Block.decode(ps.get_bytes())
+
+    def load_block_by_hash(self, block_hash: bytes) -> Optional[Block]:
+        # linear scan over metas (the reference keeps a hash->height index;
+        # do the same here lazily if it ever shows up in profiles)
+        for h in range(self.base, self.height + 1):
+            meta = self.load_block_meta(h)
+            if meta and meta.block_id.hash == block_hash:
+                return self.load_block(h)
+        return None
+
+    def load_block_part(self, height: int, index: int) -> Optional[Part]:
+        raw = self._db.get(_h(_PART, height, index))
+        return Part.decode(raw) if raw else None
+
+    def load_block_commit(self, height: int) -> Optional[Commit]:
+        """The canonical commit for `height` (stored with block height+1)."""
+        raw = self._db.get(_h(_COMMIT, height))
+        return Commit.decode(raw) if raw else None
+
+    def load_seen_commit(self, height: int) -> Optional[Commit]:
+        raw = self._db.get(_h(_SEEN, height))
+        return Commit.decode(raw) if raw else None
+
+    # --- pruning ----------------------------------------------------------
+
+    def prune_blocks(self, retain_height: int) -> int:
+        """Removes blocks below retain_height (reference :268); returns the
+        number pruned."""
+        with self._mtx:
+            if retain_height <= self._base:
+                return 0
+            if retain_height > self._height:
+                raise ValueError("cannot prune beyond store height")
+            pruned = 0
+            deletes = []
+            for h in range(self._base, retain_height):
+                meta = self.load_block_meta(h)
+                if meta is None:
+                    continue
+                deletes.append(_h(_META, h))
+                for i in range(meta.block_id.part_set_header.total):
+                    deletes.append(_h(_PART, h, i))
+                deletes.append(_h(_COMMIT, h - 1))
+                deletes.append(_h(_SEEN, h))
+                pruned += 1
+            self._base = retain_height
+            self._db.write_batch([], deletes)
+            self._save_state()
+            return pruned
+
+    def prune_blocks_since(self, height: int) -> int:
+        """Removes blocks ABOVE height — rollback support (reference :346,
+        used by the rewind/rollback tooling)."""
+        with self._mtx:
+            if height >= self._height:
+                return 0
+            if height < self._base:
+                raise ValueError("cannot rewind below store base")
+            pruned = 0
+            deletes = []
+            for h in range(height + 1, self._height + 1):
+                meta = self.load_block_meta(h)
+                if meta is None:
+                    continue
+                deletes.append(_h(_META, h))
+                for i in range(meta.block_id.part_set_header.total):
+                    deletes.append(_h(_PART, h, i))
+                if h - 1 > height:
+                    # keep the canonical commit for the retained head
+                    deletes.append(_h(_COMMIT, h - 1))
+                deletes.append(_h(_SEEN, h))
+                pruned += 1
+            self._height = height
+            self._db.write_batch([], deletes)
+            self._save_state()
+            return pruned

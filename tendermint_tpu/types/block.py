@@ -56,12 +56,17 @@ class Header:
     batch_hash: bytes = b""  # morph: L2 batch hash (types/block.go:366)
     version_block: int = BLOCK_PROTOCOL_VERSION
     version_app: int = 0
+    _hash: Optional[bytes] = field(default=None, compare=False, repr=False)
 
     def hash(self) -> bytes:
         """Merkle root over the 15 encoded header fields (the reference
-        hashes 14, types/block.go:494; batch_hash is the 15th here)."""
+        hashes 14, types/block.go:494; batch_hash is the 15th here).
+        Cached — the consensus hot path compares header hashes per vote;
+        mutators (fill_header, the batch-point edit) must reset `_hash`."""
         if not self.validators_hash:
             return b""
+        if self._hash is not None:
+            return self._hash
         fields = [
             pio.field_varint(1, self.version_block)
             + pio.field_varint(2, self.version_app),
@@ -80,7 +85,8 @@ class Header:
             self.proposer_address,
             self.batch_hash,
         ]
-        return merkle.hash_from_byte_slices(fields)
+        self._hash = merkle.hash_from_byte_slices(fields)
+        return self._hash
 
     def validate_basic(self) -> None:
         if not self.chain_id or len(self.chain_id) > 50:
@@ -323,13 +329,14 @@ class Data:
     _hash: Optional[bytes] = field(default=None, compare=False, repr=False)
 
     def hash(self) -> bytes:
+        # domain-separated leaves: txs are \x00-prefixed, the two L2
+        # payload leaves always present with their own prefixes — so no
+        # tx list can collide with an L2 payload under the same root
         if self._hash is None:
-            leaves = [tx for tx in self.txs]
-            if self.l2_block_meta or self.l2_batch_header:
-                leaves = leaves + [
-                    b"\x01" + self.l2_block_meta,
-                    b"\x02" + self.l2_batch_header,
-                ]
+            leaves = [b"\x00" + tx for tx in self.txs] + [
+                b"\x01" + self.l2_block_meta,
+                b"\x02" + self.l2_batch_header,
+            ]
             self._hash = merkle.hash_from_byte_slices(leaves)
         return self._hash
 
@@ -363,9 +370,20 @@ class Block:
     def hash(self) -> bytes:
         return self.header.hash()
 
+    def set_batch_point(self, batch_hash: bytes, batch_header: bytes) -> None:
+        """Mark this block as a batch point (morph decideBatchPoint):
+        mutates header.batch_hash + data.l2_batch_header and keeps the
+        hash caches coherent — the only sanctioned post-fill mutation."""
+        self.header.batch_hash = batch_hash
+        self.data.l2_batch_header = batch_header
+        self.data._hash = None
+        self.header._hash = None
+        self.header.data_hash = self.data.hash()
+
     def fill_header(self) -> None:
         """Computes the derived header hashes from contents
         (reference Block.fillHeader, types/block.go)."""
+        self.header._hash = None
         if not self.header.last_commit_hash and self.last_commit is not None:
             self.header.last_commit_hash = self.last_commit.hash()
         if not self.header.data_hash:

@@ -1,0 +1,65 @@
+"""Shared test factories — the analog of the reference's internal/test
+builders (internal/test/block.go, vote.go, ...)."""
+
+from __future__ import annotations
+
+import time
+
+from tendermint_tpu.types.block import Block, Commit
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.priv_validator import MockPV
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote, VoteType
+from tendermint_tpu.types.vote_set import VoteSet
+
+CHAIN_ID = "test-chain"
+T0 = 1_700_000_000_000_000_000
+
+
+def make_validators(n: int, power: int = 10, seed: bytes = b"val"):
+    """(ValidatorSet, [MockPV]) with privvals ordered to match the set."""
+    pvs = [MockPV.from_secret(seed + b"%d" % i) for i in range(n)]
+    vs = ValidatorSet([Validator(pv.get_pub_key(), power) for pv in pvs])
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    return vs, [by_addr[v.address] for v in vs.validators]
+
+
+def make_genesis(vs: ValidatorSet, chain_id: str = CHAIN_ID) -> GenesisDoc:
+    doc = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=T0,
+        validators=[
+            GenesisValidator("ed25519", v.pub_key.data, v.voting_power)
+            for v in vs.validators
+        ],
+    )
+    doc.validate_and_complete()
+    return doc
+
+
+def sign_commit(
+    vs: ValidatorSet,
+    pvs: list,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    chain_id: str = CHAIN_ID,
+    time_ns: int = T0,
+) -> Commit:
+    """All validators precommit block_id; returns the Commit."""
+    votes = VoteSet(chain_id, height, round_, VoteType.PRECOMMIT, vs)
+    for i, pv in enumerate(pvs):
+        v = Vote(
+            type=VoteType.PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp_ns=time_ns + i,
+            validator_address=pv.get_pub_key().address(),
+            validator_index=i,
+        )
+        pv.sign_vote(chain_id, v)
+        votes.add_vote(v, verified=True)
+    return votes.make_commit()

@@ -7,11 +7,16 @@ import numpy as np
 import pytest
 
 from tendermint_tpu.crypto import ed25519 as host
-from tendermint_tpu.crypto.batch_verifier import (
-    BatchVerifier,
-    SigItem,
-    default_verifier,
-)
+from tendermint_tpu.crypto.batch_verifier import BatchVerifier, SigItem
+
+# differential tests must exercise the DEVICE kernel even for tiny
+# batches — min_device_batch=0 disables the host fast path that
+# production uses for latency
+_verifier = BatchVerifier(min_device_batch=0)
+
+
+def default_verifier():
+    return _verifier
 
 
 def _keypairs(n, seed=b"bv"):

@@ -1,0 +1,204 @@
+"""Consensus state machine e2e: the reconstruction of the test net the
+fork deleted (consensus/common_test.go, SURVEY.md §4.1) — in-proc
+validators wired through broadcast hooks, no p2p."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus.state_machine import (
+    ConsensusConfig,
+    ConsensusState,
+)
+from tendermint_tpu.consensus.messages import (
+    BlockPartMessage,
+    ProposalMessage,
+    VoteMessage,
+)
+from tendermint_tpu.l2node.mock import MockL2Node
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.store.kv import MemKV
+from tendermint_tpu.types.priv_validator import MockPV
+
+from .helpers import CHAIN_ID, make_genesis, make_validators
+
+
+def make_node(
+    vs,
+    pv,
+    genesis,
+    l2=None,
+    config=None,
+    upgrade_height=0,
+    on_upgrade=None,
+    bls_signer=None,
+):
+    l2 = l2 or MockL2Node()
+    app = KVStoreApplication()
+    state = State.from_genesis(genesis)
+    state_store = StateStore(MemKV())
+    state_store.bootstrap(state)
+    block_store = BlockStore(MemKV())
+    executor = BlockExecutor(state_store, block_store, LocalClient(app), l2)
+    cs = ConsensusState(
+        config or ConsensusConfig.test_config(),
+        state,
+        executor,
+        block_store,
+        l2,
+        priv_validator=pv,
+        upgrade_height=upgrade_height,
+        on_upgrade=on_upgrade,
+        bls_signer=bls_signer,
+    )
+    return cs, app, l2, block_store, state_store
+
+
+def wire_net(nodes):
+    """Full-mesh gossip of self-produced messages (in-proc harness)."""
+    for i, n in enumerate(nodes):
+        def hook(msg, i=i):
+            for j, other in enumerate(nodes):
+                if j != i:
+                    other.peer_msg_queue.put_nowait((msg, f"node{i}"))
+
+        n.broadcast_hook = hook
+
+
+def test_single_validator_chain():
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+
+    async def run():
+        cs, app, l2, bs, ss = make_node(vs, pvs[0], genesis)
+        await cs.start()
+        await cs.wait_for_height(3, timeout=20)
+        await cs.stop()
+        assert cs.state.last_block_height >= 3
+        assert bs.height >= 3
+        assert len(l2.delivered) >= 3
+        # blocks chain correctly
+        b2 = bs.load_block(2)
+        b3 = bs.load_block(3)
+        assert b3.header.last_block_id.hash == b2.hash()
+        assert b3.last_commit is not None
+        # commits verify against the validator set
+        vs_now = ss.load_validators(2)
+        vs_now.verify_commit_light(
+            CHAIN_ID, b3.header.last_block_id, 2, b3.last_commit
+        )
+
+    asyncio.run(run())
+
+
+def test_four_validator_net():
+    vs, pvs = make_validators(4)
+    genesis = make_genesis(vs)
+
+    async def run():
+        nodes = [make_node(vs, pv, genesis) for pv in pvs]
+        css = [n[0] for n in nodes]
+        wire_net(css)
+        for cs in css:
+            await cs.start()
+        await asyncio.gather(*(cs.wait_for_height(3, timeout=30) for cs in css))
+        for cs in css:
+            await cs.stop()
+        hashes = {cs.block_store.load_block(3).hash() for cs in css}
+        assert len(hashes) == 1, "nodes disagree on block 3"
+        for cs in css:
+            assert cs.state.last_block_height >= 3
+
+    asyncio.run(run())
+
+
+def test_net_survives_one_faulty_node():
+    """3 of 4 validators are enough for progress (one node never starts)."""
+    vs, pvs = make_validators(4)
+    genesis = make_genesis(vs)
+
+    async def run():
+        nodes = [make_node(vs, pv, genesis) for pv in pvs]
+        css = [n[0] for n in nodes]
+        wire_net(css)
+        for cs in css[:3]:  # node 3 stays down
+            await cs.start()
+        await asyncio.gather(
+            *(cs.wait_for_height(2, timeout=40) for cs in css[:3])
+        )
+        for cs in css[:3]:
+            await cs.stop()
+        for cs in css[:3]:
+            assert cs.state.last_block_height >= 2
+        # commits at height 2 include an absent signature for node 3
+        b = css[0].block_store.load_block(3)
+        if b is None:
+            commit = css[0].block_store.load_seen_commit(2)
+        else:
+            commit = b.last_commit
+        assert any(cs_.is_absent() for cs_ in commit.signatures)
+
+    asyncio.run(run())
+
+
+def test_batch_point_bls_flow():
+    """Every 2nd block is a batch point: header carries the batch hash,
+    precommits carry BLS signatures, the L2 node receives CommitBatch with
+    the aggregated BLS data (morph capability, SURVEY.md delta 2)."""
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    l2 = MockL2Node(batch_blocks_interval=2)
+
+    async def run():
+        cs, app, l2_, bs, ss = make_node(
+            vs,
+            pvs[0],
+            genesis,
+            l2=l2,
+            bls_signer=lambda batch_hash: b"bls:" + batch_hash[:28],
+        )
+        await cs.start()
+        await cs.wait_for_height(4, timeout=30)
+        await cs.stop()
+        batch_blocks = [
+            bs.load_block(h)
+            for h in range(1, 5)
+            if bs.load_block(h).header.batch_hash
+        ]
+        assert batch_blocks, "no batch points produced"
+        assert l2.committed_batches, "no batches committed to L2"
+        batch_hash, bls_datas = l2.committed_batches[0]
+        assert bls_datas and bls_datas[0].signature.startswith(b"bls:")
+        assert l2.bls_appended  # AppendBlsData was called per precommit
+        # the batch-point block's data carries the sealed batch header
+        assert batch_blocks[0].data.l2_batch_header
+
+    asyncio.run(run())
+
+
+def test_upgrade_switch_stops_bft():
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    upgraded = []
+
+    async def run():
+        cs, *_ = make_node(
+            vs,
+            pvs[0],
+            genesis,
+            upgrade_height=2,
+            on_upgrade=lambda st: upgraded.append(st.last_block_height),
+        )
+        await cs.start()
+        await cs.wait_for_height(2, timeout=20)
+        await asyncio.sleep(0.5)  # give it room to (wrongly) keep going
+        await cs.stop()
+        assert upgraded == [2]
+        assert cs.state.last_block_height == 2  # BFT stopped at upgrade
+
+    asyncio.run(run())

@@ -1,0 +1,254 @@
+"""P2P tests: secret connection, mconn multiplexing, switch, pex."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto import aead, ed25519
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.mconn import ChannelDescriptor, MConnection
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.pex import AddrBook, PEXReactor
+from tendermint_tpu.p2p.secret_connection import SecretConnection
+from tendermint_tpu.p2p.switch import Reactor, Switch
+from tendermint_tpu.p2p.transport import (
+    MultiplexTransport,
+    NetAddress,
+    Peer,
+)
+
+NETWORK = "p2p-test-chain"
+
+
+async def _pipe_pair():
+    """Two connected (reader, writer) pairs over localhost TCP."""
+    accepted = asyncio.Queue()
+
+    async def on_conn(r, w):
+        await accepted.put((r, w))
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    r1, w1 = await asyncio.open_connection("127.0.0.1", port)
+    r2, w2 = await accepted.get()
+    return (r1, w1), (r2, w2), server
+
+
+def test_secret_connection_handshake_and_data():
+    async def run():
+        (r1, w1), (r2, w2), server = await _pipe_pair()
+        k1, k2 = ed25519.PrivKey.generate(), ed25519.PrivKey.generate()
+        c1, c2 = await asyncio.gather(
+            SecretConnection.make(r1, w1, k1),
+            SecretConnection.make(r2, w2, k2),
+        )
+        # authenticated identities
+        assert c1.remote_pubkey.data == k2.public_key().data
+        assert c2.remote_pubkey.data == k1.public_key().data
+        # bidirectional data incl. multi-frame messages
+        await c1.write(b"hello")
+        assert await c2.read_exactly(5) == b"hello"
+        big = bytes(range(256)) * 20  # 5120 bytes -> 6 frames
+        await c2.write(big)
+        assert await c1.read_exactly(len(big)) == big
+        c1.close(); c2.close(); server.close()
+
+    asyncio.run(run())
+
+
+def test_secret_connection_rejects_tampering():
+    async def run():
+        (r1, w1), (r2, w2), server = await _pipe_pair()
+        k1, k2 = ed25519.PrivKey.generate(), ed25519.PrivKey.generate()
+        c1, c2 = await asyncio.gather(
+            SecretConnection.make(r1, w1, k1),
+            SecretConnection.make(r2, w2, k2),
+        )
+        # inject a corrupted frame directly into the raw socket
+        from tendermint_tpu.p2p.secret_connection import SEALED_FRAME_SIZE
+
+        w1.write(b"\x00" * SEALED_FRAME_SIZE)
+        await w1.drain()
+        with pytest.raises(ValueError):
+            await c2.read()
+        c1.close(); c2.close(); server.close()
+
+    asyncio.run(run())
+
+
+def test_mconn_multiplexing_priorities():
+    async def run():
+        (r1, w1), (r2, w2), server = await _pipe_pair()
+        k1, k2 = ed25519.PrivKey.generate(), ed25519.PrivKey.generate()
+        c1, c2 = await asyncio.gather(
+            SecretConnection.make(r1, w1, k1),
+            SecretConnection.make(r2, w2, k2),
+        )
+        got = asyncio.Queue()
+
+        async def on_recv(ch, msg):
+            await got.put((ch, msg))
+
+        descs = [
+            ChannelDescriptor(id=0x20, priority=5),
+            ChannelDescriptor(id=0x21, priority=10),
+        ]
+        m1 = MConnection(c1, descs, lambda ch, m: asyncio.sleep(0))
+        m2 = MConnection(c2, descs, on_recv)
+        m1.start(); m2.start()
+        # interleave channels; large message forces multi-packet reassembly
+        big = b"B" * 5000
+        assert m1.send(0x20, b"small")
+        assert m1.send(0x21, big)
+        seen = {}
+        for _ in range(2):
+            ch, msg = await asyncio.wait_for(got.get(), 5)
+            seen[ch] = msg
+        assert seen[0x20] == b"small"
+        assert seen[0x21] == big
+        await m1.stop(); await m2.stop(); server.close()
+
+    asyncio.run(run())
+
+
+def _make_switch(name: str, reactors=None, network=NETWORK):
+    nk = NodeKey.generate()
+    transport = None
+    sw = None
+
+    def node_info():
+        return NodeInfo(
+            node_id=nk.id,
+            listen_addr=f"127.0.0.1:{transport.listen_port}",
+            network=network,
+            moniker=name,
+            channels=sw.channels() if sw else b"",
+        )
+
+    transport = MultiplexTransport(nk, node_info)
+    sw = Switch(transport)
+    for rname, r in (reactors or {}).items():
+        sw.add_reactor(rname, r)
+    return nk, transport, sw
+
+
+class EchoReactor(Reactor):
+    CH = 0x31
+
+    def __init__(self):
+        super().__init__("echo")
+        self.received = asyncio.Queue()
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.CH)]
+
+    async def receive(self, channel_id, peer, msg):
+        await self.received.put((peer.id, msg))
+
+
+def test_switch_connect_and_route():
+    async def run():
+        e1, e2 = EchoReactor(), EchoReactor()
+        nk1, t1, sw1 = _make_switch("n1", {"echo": e1})
+        nk2, t2, sw2 = _make_switch("n2", {"echo": e2})
+        await t1.listen(); await t2.listen()
+        await sw1.start(); await sw2.start()
+        peer = await sw1.dial_peer(
+            NetAddress(nk2.id, "127.0.0.1", t2.listen_port)
+        )
+        assert peer is not None
+        for _ in range(50):  # inbound side registers asynchronously
+            if sw2.num_peers() == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert sw2.num_peers() == 1
+        # route a message n1 -> n2 over the echo channel
+        assert peer.send(EchoReactor.CH, b"ping over channel")
+        pid, msg = await asyncio.wait_for(e2.received.get(), 5)
+        assert pid == nk1.id and msg == b"ping over channel"
+        # broadcast the other way
+        sw2.broadcast(EchoReactor.CH, b"bcast")
+        pid, msg = await asyncio.wait_for(e1.received.get(), 5)
+        assert msg == b"bcast"
+        await sw1.stop(); await sw2.stop()
+
+    asyncio.run(run())
+
+
+def test_switch_rejects_wrong_network():
+    async def run():
+        nk1, t1, sw1 = _make_switch("n1", network="chain-A")
+        nk2, t2, sw2 = _make_switch("n2", network="chain-B")
+        await t1.listen(); await t2.listen()
+        await sw1.start(); await sw2.start()
+        with pytest.raises(ValueError, match="network"):
+            await sw1.dial_peer(NetAddress(nk2.id, "127.0.0.1", t2.listen_port))
+        assert sw1.num_peers() == 0
+        await sw1.stop(); await sw2.stop()
+
+    asyncio.run(run())
+
+
+def test_switch_detects_id_mismatch():
+    async def run():
+        nk1, t1, sw1 = _make_switch("n1")
+        nk2, t2, sw2 = _make_switch("n2")
+        await t1.listen(); await t2.listen()
+        await sw1.start(); await sw2.start()
+        wrong_id = NodeKey.generate().id
+        with pytest.raises(ValueError, match="authenticated"):
+            await sw1.dial_peer(
+                NetAddress(wrong_id, "127.0.0.1", t2.listen_port)
+            )
+        await sw1.stop(); await sw2.stop()
+
+    asyncio.run(run())
+
+
+def test_addrbook_persistence(tmp_path):
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path, our_id="f" * 40)
+    a1 = NetAddress("a" * 40, "10.0.0.1", 26656)
+    a2 = NetAddress("b" * 40, "10.0.0.2", 26656)
+    assert book.add_address(a1)
+    assert not book.add_address(a1)  # dup
+    assert book.add_address(a2)
+    assert not book.add_address(NetAddress("f" * 40, "1.1.1.1", 1))  # self
+    book.mark_good(a1.id)
+    book.mark_attempt(a2.id)
+    book.save()
+    book2 = AddrBook(path, our_id="f" * 40)
+    assert book2.size() == 2
+    picked = book2.pick_address(exclude=set())
+    assert picked is not None
+    sel = book2.get_selection()
+    assert len(sel) == 2
+
+
+def test_pex_gossip_discovers_peers():
+    """n3 knows only n1; n1 knows n2; pex spreads the addresses until n3
+    connects to n2 as well."""
+
+    async def run():
+        books = [AddrBook() for _ in range(3)]
+        pexes = [PEXReactor(books[i], target_outbound=5) for i in range(3)]
+        nodes = [
+            _make_switch(f"n{i}", {"pex": pexes[i]}) for i in range(3)
+        ]
+        for i, (nk, t, sw) in enumerate(nodes):
+            books[i]._our_id = nk.id
+            await t.listen()
+            await sw.start()
+        (nk1, t1, sw1), (nk2, t2, sw2), (nk3, t3, sw3) = nodes
+        # seed address books
+        books[0].add_address(NetAddress(nk2.id, "127.0.0.1", t2.listen_port))
+        books[2].add_address(NetAddress(nk1.id, "127.0.0.1", t1.listen_port))
+        for _ in range(100):
+            if nk2.id in sw3.peers:
+                break
+            await asyncio.sleep(0.1)
+        assert nk2.id in sw3.peers, "pex did not propagate n2's address to n3"
+        for _, _, sw in nodes:
+            await sw.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,190 @@
+"""Stores, state, executor, ABCI, and L2 bridge tests."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci.client import LocalClient, SocketClient, SocketServer
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.l2node.mock import MockL2Node
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.store.kv import MemKV, SqliteKV
+from tendermint_tpu.types.block_id import BlockID
+
+from .helpers import CHAIN_ID, T0, make_genesis, make_validators, sign_commit
+
+
+# --- kv -------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("make_db", [MemKV, lambda: None])
+def test_kv_roundtrip(make_db, tmp_path):
+    db = make_db() or SqliteKV(str(tmp_path / "kv.db"))
+    db.set(b"a", b"1")
+    db.set(b"b", b"2")
+    db.set(b"c", b"3")
+    assert db.get(b"b") == b"2"
+    assert db.get(b"zz") is None
+    db.delete(b"b")
+    assert db.get(b"b") is None
+    db.write_batch([(b"d", b"4"), (b"e", b"5")], [b"a"])
+    assert [k for k, _ in db.iterate()] == [b"c", b"d", b"e"]
+    assert [k for k, _ in db.iterate(b"d")] == [b"d", b"e"]
+    assert [k for k, _ in db.iterate(b"", b"d")] == [b"c"]
+    db.close()
+
+
+# --- chain fixture --------------------------------------------------------
+
+
+def build_chain(n_blocks=3, n_vals=3):
+    """A valid chain of blocks + commits via the executor-independent
+    path: state transitions computed with a MockL2Node + kvstore app."""
+    vs, pvs = make_validators(n_vals)
+    genesis = make_genesis(vs)
+    state = State.from_genesis(genesis)
+    l2 = MockL2Node()
+    app = KVStoreApplication()
+    state_store = StateStore(MemKV())
+    block_store = BlockStore(MemKV())
+    executor = BlockExecutor(
+        state_store, block_store, LocalClient(app), l2
+    )
+
+    async def run():
+        nonlocal state
+        res = await executor._app.init_chain(
+            CHAIN_ID, {}, [], {}, genesis.initial_height
+        )
+        state.app_hash = res.app_hash
+        state_store.bootstrap(state)
+        last_commit = None
+        blocks = []
+        for h in range(1, n_blocks + 1):
+            bd = l2.request_block_data(h)
+            proposer = state.validators.get_proposer()
+            block = executor.create_proposal_block(
+                h, state, last_commit, proposer.address, bd, T0 + h * 10**9
+            )
+            ps = block.make_part_set()
+            bid = BlockID(block.hash(), ps.header)
+            seen_commit = sign_commit(vs, pvs, h, 0, bid, time_ns=T0 + h * 10**9)
+            block_store.save_block(block, ps, seen_commit)
+            state = await executor.apply_block(state, bid, block)
+            blocks.append((block, bid, seen_commit))
+            last_commit = seen_commit
+        return blocks
+
+    blocks = asyncio.run(run())
+    return vs, pvs, state, block_store, state_store, blocks, l2, app
+
+
+def test_executor_applies_chain():
+    vs, pvs, state, block_store, state_store, blocks, l2, app = build_chain(3)
+    assert state.last_block_height == 3
+    assert block_store.height == 3 and block_store.base == 1
+    assert len(l2.delivered) == 3
+    # app executed the txs: one app commit per block
+    assert app._height == 3
+    # stored state round-trips
+    loaded = state_store.load()
+    assert loaded.last_block_height == 3
+    assert loaded.validators.hash() == state.validators.hash()
+    assert loaded.app_hash == state.app_hash
+    # validator sets by height are retrievable
+    assert state_store.load_validators(2).hash() == vs.hash()
+
+
+def test_block_store_roundtrip_and_prune():
+    vs, pvs, state, block_store, state_store, blocks, _, _ = build_chain(3)
+    b2 = block_store.load_block(2)
+    assert b2.hash() == blocks[1][0].hash()
+    meta = block_store.load_block_meta(2)
+    assert meta.block_id == blocks[1][1]
+    assert block_store.load_seen_commit(2).hash() == blocks[1][2].hash()
+    # commit for height 1 came from block 2's last_commit
+    assert block_store.load_block_commit(1).hash() == blocks[0][2].hash()
+    assert block_store.load_block_by_hash(b2.hash()).header.height == 2
+    # prune below 3
+    assert block_store.prune_blocks(3) == 2
+    assert block_store.base == 3
+    assert block_store.load_block(2) is None
+    assert block_store.load_block(3) is not None
+
+
+def test_block_store_rewind():
+    _, _, _, block_store, _, blocks, _, _ = build_chain(3)
+    assert block_store.prune_blocks_since(1) == 2
+    assert block_store.height == 1
+    assert block_store.load_block(2) is None
+    assert block_store.load_block(1) is not None
+
+
+def test_state_store_rollback():
+    vs, pvs, state, block_store, state_store, blocks, _, _ = build_chain(3)
+    rolled = state_store.rollback(block_store)
+    assert rolled.last_block_height == 2
+    assert rolled.app_hash == blocks[2][0].header.app_hash
+    assert state_store.load().last_block_height == 2
+
+
+def test_executor_rejects_invalid_block():
+    vs, pvs, state, block_store, state_store, blocks, l2, app = build_chain(2)
+    block, bid, _ = blocks[1]
+    # replaying an old block against the new state must fail (wrong height)
+    with pytest.raises(ValueError):
+        asyncio.run(
+            BlockExecutor(
+                state_store, block_store, LocalClient(app), l2
+            ).apply_block(state, bid, block)
+        )
+
+
+# --- abci socket ----------------------------------------------------------
+
+
+def test_abci_socket_roundtrip():
+    async def run():
+        app = KVStoreApplication()
+        server = SocketServer(app, port=0)
+        await server.start()
+        client = SocketClient(port=server.port)
+        await client.connect()
+        assert await client.echo("hi") == "hi"
+        info = await client.info()
+        assert info.data == "kvstore"
+        r = await client.deliver_tx(b"k=v")
+        assert r.is_ok()
+        c = await client.commit()
+        assert len(c.data) == 32
+        q = await client.query("/key", b"k", 0, False)
+        assert q.value == b"v"
+        # pipelining: several in-flight calls keep FIFO order
+        outs = await asyncio.gather(
+            *(client.echo(f"m{i}") for i in range(5))
+        )
+        assert outs == [f"m{i}" for i in range(5)]
+        await client.close()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+# --- l2 mock batching -----------------------------------------------------
+
+
+def test_mock_l2_batching():
+    l2 = MockL2Node(batch_blocks_interval=3)
+    assert not l2.calculate_batch_size_with_proposal_block(b"b1", False)
+    l2.pack_current_block(b"b1")
+    l2.pack_current_block(b"b2")
+    # third block hits the interval -> batch point
+    assert l2.calculate_batch_size_with_proposal_block(b"b3", False)
+    h, header = l2.seal_batch()
+    assert l2.batch_hash(header) == h
+    l2.commit_batch(b"b3", [])
+    assert len(l2.committed_batches) == 1
+    assert l2.open_batch_blocks == [b"b3"]
