@@ -1,0 +1,181 @@
+"""BlockPool — parallel block fetching with per-peer accounting.
+
+Reference: blocksync/pool.go:63-560 — a window of in-flight height
+requests, each assigned to a peer advertising that height; peers that
+stall or send garbage are reported and their requests reassigned.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..libs.log import Logger, nop_logger
+from ..types.block import Block
+
+REQUEST_WINDOW = 40  # max heights in flight (reference maxPendingRequests)
+REQUEST_TIMEOUT = 8.0
+
+
+@dataclass
+class _PoolPeer:
+    peer_id: str
+    base: int
+    height: int
+    pending: set[int] = field(default_factory=set)
+    timeouts: int = 0
+
+
+@dataclass
+class _Requester:
+    height: int
+    peer_id: str = ""
+    block: Optional[Block] = None
+    requested_at: float = 0.0
+
+
+class BlockPool:
+    """send_request(peer_id, height) is injected by the reactor;
+    on_peer_error(peer_id, reason) reports misbehaving peers."""
+
+    def __init__(
+        self,
+        start_height: int,
+        send_request: Callable[[str, int], bool],
+        on_peer_error: Callable[[str, str], None],
+        logger: Optional[Logger] = None,
+    ):
+        self.height = start_height  # next height to process
+        self._send_request = send_request
+        self._on_peer_error = on_peer_error
+        self.logger = logger or nop_logger()
+        self._peers: dict[str, _PoolPeer] = {}
+        self._requesters: dict[int, _Requester] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.started_at = time.monotonic()
+
+    # --- peer bookkeeping -------------------------------------------------
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        p = self._peers.get(peer_id)
+        if p is None:
+            self._peers[peer_id] = _PoolPeer(peer_id, base, height)
+        else:
+            p.base, p.height = base, height
+
+    def remove_peer(self, peer_id: str) -> None:
+        p = self._peers.pop(peer_id, None)
+        if p is None:
+            return
+        for h in list(p.pending):
+            r = self._requesters.get(h)
+            if r is not None and r.block is None:
+                r.peer_id = ""
+                r.requested_at = 0.0
+
+    def max_peer_height(self) -> int:
+        return max((p.height for p in self._peers.values()), default=0)
+
+    def is_caught_up(self) -> bool:
+        """Reference IsCaughtUp: some peers known, and our height reached
+        the best peer height."""
+        if not self._peers:
+            return time.monotonic() - self.started_at > 5.0
+        return self.height >= self.max_peer_height()
+
+    def num_pending(self) -> int:
+        return sum(1 for r in self._requesters.values() if r.block is None)
+
+    # --- request scheduling ----------------------------------------------
+
+    def make_requests(self) -> None:
+        """Ensure up to REQUEST_WINDOW requesters exist and are assigned."""
+        target = self.max_peer_height()
+        for h in range(self.height, min(self.height + REQUEST_WINDOW, target + 1)):
+            if h not in self._requesters:
+                self._requesters[h] = _Requester(h)
+        now = time.monotonic()
+        for r in self._requesters.values():
+            if r.block is not None:
+                continue
+            if r.peer_id and now - r.requested_at < REQUEST_TIMEOUT:
+                continue
+            if r.peer_id:  # timed out
+                self._timeout_peer(r.peer_id, r.height)
+            peer = self._pick_peer(r.height)
+            if peer is None:
+                continue
+            if self._send_request(peer.peer_id, r.height):
+                r.peer_id = peer.peer_id
+                r.requested_at = now
+                peer.pending.add(r.height)
+
+    def _pick_peer(self, height: int) -> Optional[_PoolPeer]:
+        candidates = [
+            p
+            for p in self._peers.values()
+            if p.base <= height <= p.height and len(p.pending) < 20
+        ]
+        if not candidates:
+            return None
+        return candidates[secrets.randbelow(len(candidates))]
+
+    def _timeout_peer(self, peer_id: str, height: int) -> None:
+        p = self._peers.get(peer_id)
+        if p is not None:
+            p.pending.discard(height)
+            p.timeouts += 1
+            if p.timeouts >= 3:
+                self._on_peer_error(peer_id, "blocksync request timeouts")
+                self.remove_peer(peer_id)
+
+    # --- block ingestion --------------------------------------------------
+
+    def add_block(self, peer_id: str, block: Block) -> bool:
+        h = block.header.height
+        r = self._requesters.get(h)
+        if r is None or r.block is not None:
+            return False
+        if r.peer_id and r.peer_id != peer_id:
+            return False  # unsolicited from a different peer
+        r.block = block
+        r.peer_id = peer_id
+        p = self._peers.get(peer_id)
+        if p is not None:
+            p.pending.discard(h)
+        return True
+
+    def no_block(self, peer_id: str, height: int) -> None:
+        r = self._requesters.get(height)
+        if r is not None and r.peer_id == peer_id and r.block is None:
+            r.peer_id = ""
+            r.requested_at = 0.0
+        p = self._peers.get(peer_id)
+        if p is not None:
+            p.pending.discard(height)
+
+    def peek_two_blocks(self) -> tuple[Optional[Block], Optional[Block]]:
+        first = self._requesters.get(self.height)
+        second = self._requesters.get(self.height + 1)
+        return (
+            first.block if first else None,
+            second.block if second else None,
+        )
+
+    def pop_request(self) -> None:
+        self._requesters.pop(self.height, None)
+        self.height += 1
+
+    def redo_request(self, height: int, reason: str) -> None:
+        """First block failed verification: ditch both blocks and punish
+        the senders (reference RedoRequest)."""
+        for h in (height, height + 1):
+            r = self._requesters.get(h)
+            if r is not None:
+                if r.peer_id:
+                    self._on_peer_error(r.peer_id, reason)
+                    self.remove_peer(r.peer_id)
+                self._requesters[h] = _Requester(h)

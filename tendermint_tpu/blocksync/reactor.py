@@ -1,0 +1,268 @@
+"""Blocksync reactor — channel 0x40 fast sync with batched commit verify.
+
+Reference: blocksync/reactor.go — messages BlockRequest/BlockResponse/
+NoBlockResponse/StatusRequest/StatusResponse (:21-22); poolRoutine
+:387-663: peek two blocks, verify `first` using `second.LastCommit` via
+VerifyCommitLight (:553 — HERE the TPU batch kernel replaces the serial
+per-signer loop), check batch hash + BLS data (:558-600), apply, and
+switch to consensus (or sequencer mode post-upgrade, :461-485) once
+caught up.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+from ..l2node.l2node import BlsData
+from ..libs import protoio as pio
+from ..libs.log import Logger, nop_logger
+from ..p2p.mconn import ChannelDescriptor
+from ..p2p.switch import Reactor
+from ..p2p.transport import Peer
+from ..state.execution import BlockExecutor
+from ..state.state import State
+from ..store.block_store import BlockStore
+from ..types.block import Block
+from ..types.block_id import BlockID
+from .pool import BlockPool
+
+BLOCKSYNC_CHANNEL = 0x40
+
+_REQ = 1
+_RESP = 2
+_NO_BLOCK = 3
+_STATUS_REQ = 4
+_STATUS_RESP = 5
+
+
+def _enc(kind: int, **fields) -> bytes:
+    out = pio.field_varint(1, kind)
+    if "height" in fields:
+        out += pio.field_varint(2, fields["height"])
+    if "block" in fields:
+        out += pio.field_bytes(3, fields["block"])
+    if "base" in fields:
+        out += pio.field_varint(4, fields["base"] + 1)
+    return out
+
+
+class BlocksyncReactor(Reactor):
+    def __init__(
+        self,
+        state: State,
+        executor: BlockExecutor,
+        block_store: BlockStore,
+        l2_node,
+        on_caught_up: Optional[Callable] = None,
+        upgrade_height: int = 0,
+        on_upgrade: Optional[Callable] = None,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("blocksync")
+        self.state = state
+        self.executor = executor
+        self.block_store = block_store
+        self.l2 = l2_node
+        self.on_caught_up = on_caught_up
+        self.upgrade_height = upgrade_height
+        self.on_upgrade = on_upgrade
+        self.logger = logger or nop_logger()
+        self.pool = BlockPool(
+            start_height=max(state.last_block_height + 1, state.initial_height),
+            send_request=self._send_block_request,
+            on_peer_error=self._report_peer,
+        )
+        self._task: Optional[asyncio.Task] = None
+        self.synced = asyncio.Event()
+        self.blocks_applied = 0
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                id=BLOCKSYNC_CHANNEL, priority=5, send_queue_capacity=1000
+            )
+        ]
+
+    async def on_start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._pool_routine()
+        )
+
+    async def on_stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    # --- wire -------------------------------------------------------------
+
+    def _send_block_request(self, peer_id: str, height: int) -> bool:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is None:
+            return False
+        return peer.send(BLOCKSYNC_CHANNEL, _enc(_REQ, height=height))
+
+    def _report_peer(self, peer_id: str, reason: str) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            asyncio.get_running_loop().create_task(
+                self.switch.stop_peer_for_error(peer, reason)
+            )
+
+    async def add_peer(self, peer: Peer) -> None:
+        # announce our status; ask for theirs
+        peer.send(
+            BLOCKSYNC_CHANNEL,
+            _enc(
+                _STATUS_RESP,
+                height=self.block_store.height,
+                base=self.block_store.base,
+            ),
+        )
+        peer.send(BLOCKSYNC_CHANNEL, _enc(_STATUS_REQ, height=0))
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        self.pool.remove_peer(peer.id)
+
+    async def receive(self, channel_id: int, peer: Peer, msg: bytes) -> None:
+        f = pio.decode_fields(msg)
+        kind = f.get(1, [0])[0]
+        height = f.get(2, [0])[0]
+        if kind == _REQ:
+            block = self.block_store.load_block(height)
+            if block is not None:
+                peer.send(
+                    BLOCKSYNC_CHANNEL,
+                    _enc(_RESP, height=height, block=block.encode()),
+                )
+            else:
+                peer.send(BLOCKSYNC_CHANNEL, _enc(_NO_BLOCK, height=height))
+        elif kind == _RESP:
+            try:
+                block = Block.decode(f[3][0])
+            except (KeyError, ValueError, EOFError) as e:
+                await self.switch.stop_peer_for_error(
+                    peer, f"undecodable block: {e}"
+                )
+                return
+            self.pool.add_block(peer.id, block)
+        elif kind == _NO_BLOCK:
+            self.pool.no_block(peer.id, height)
+        elif kind == _STATUS_REQ:
+            peer.send(
+                BLOCKSYNC_CHANNEL,
+                _enc(
+                    _STATUS_RESP,
+                    height=self.block_store.height,
+                    base=self.block_store.base,
+                ),
+            )
+        elif kind == _STATUS_RESP:
+            base = f.get(4, [1])[0] - 1
+            self.pool.set_peer_range(peer.id, base, height)
+
+    # --- the sync loop ----------------------------------------------------
+
+    async def _pool_routine(self) -> None:
+        """reference poolRoutine :387-663."""
+        status_tick = 0.0
+        try:
+            while True:
+                self.pool.make_requests()
+                await self._process_ready_blocks()
+                status_tick += 0.05
+                if status_tick >= 5.0:
+                    status_tick = 0.0
+                    if self.switch:
+                        self.switch.broadcast(
+                            BLOCKSYNC_CHANNEL, _enc(_STATUS_REQ, height=0)
+                        )
+                if self.pool.is_caught_up() and self.pool.num_pending() == 0:
+                    await self._switch_over()
+                    return
+                await asyncio.sleep(0.05)
+        except asyncio.CancelledError:
+            pass
+
+    async def _process_ready_blocks(self) -> None:
+        while True:
+            first, second = self.pool.peek_two_blocks()
+            if first is None or second is None:
+                return
+            first_parts = first.make_part_set()
+            first_id = BlockID(first.hash(), first_parts.header)
+            try:
+                # verify first via second's LastCommit — ONE batched device
+                # verification instead of the serial loop
+                # (reference reactor.go:553)
+                if second.last_commit is None:
+                    raise ValueError("second block has no last commit")
+                self.state.validators.verify_commit_light(
+                    self.state.chain_id,
+                    first_id,
+                    first.header.height,
+                    second.last_commit,
+                )
+                bls_datas = self._check_batch_data(first, second)
+            except ValueError as e:
+                self.logger.info(
+                    "invalid block in blocksync", height=first.header.height, err=repr(e)
+                )
+                self.pool.redo_request(first.header.height, repr(e))
+                return
+            self.block_store.save_block(first, first_parts, second.last_commit)
+            self.state = await self.executor.apply_block(
+                self.state, first_id, first, bls_datas
+            )
+            self.blocks_applied += 1
+            self.pool.pop_request()
+            if (
+                self.upgrade_height
+                and first.header.height >= self.upgrade_height
+            ):
+                # post-upgrade blocks are sequencer blocks; hand off
+                await self._switch_over()
+                raise asyncio.CancelledError
+
+    def _check_batch_data(self, first: Block, second: Block) -> list[BlsData]:
+        """Batch-hash + BLS checks (reference reactor.go:558-600)."""
+        if not first.header.batch_hash:
+            return []
+        expect = self.l2.batch_hash(first.data.l2_batch_header)
+        if expect != first.header.batch_hash:
+            raise ValueError("batch hash mismatch in synced block")
+        bls_datas = []
+        for i, cs in enumerate(second.last_commit.signatures):
+            if cs.is_absent() or not cs.bls_signature:
+                continue
+            idx, val = self.state.validators.get_by_address(
+                cs.validator_address
+            )
+            if val is None:
+                continue
+            if not self.l2.verify_signature(
+                val.pub_key.data, first.header.batch_hash, cs.bls_signature
+            ):
+                raise ValueError("invalid BLS signature in synced commit")
+            bls_datas.append(
+                BlsData(cs.validator_address, cs.bls_signature)
+            )
+        return bls_datas
+
+    async def _switch_over(self) -> None:
+        """SwitchToConsensus / sequencer handoff (reference :461-485)."""
+        self.synced.set()
+        if (
+            self.upgrade_height
+            and self.state.last_block_height >= self.upgrade_height
+        ):
+            if self.on_upgrade is not None:
+                res = self.on_upgrade(self.state)
+                if asyncio.iscoroutine(res):
+                    await res
+            return
+        if self.on_caught_up is not None:
+            res = self.on_caught_up(self.state)
+            if asyncio.iscoroutine(res):
+                await res

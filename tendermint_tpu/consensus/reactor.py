@@ -1,0 +1,518 @@
+"""Consensus reactor — gossips rounds, proposals, block parts, and votes.
+
+Reference: consensus/reactor.go — 4 channels State(0x20)/Data(0x21)/
+Vote(0x22)/VoteSetBits(0x23) (:28-31), per-peer `PeerState` HRS+bitarray
+bookkeeping (:969-1260), and three pull-based gossip routines per peer:
+gossipDataRoutine :531 (block parts + catchup :628), gossipVotesRoutine
+:671, queryMaj23Routine :804. The shape is preserved: gossip is PULL —
+routines compare our RoundState against the peer's claimed state and send
+what the peer is missing; the broadcast hook pushes our own fresh
+messages as an accelerator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..libs.bits import BitArray
+from ..libs.log import Logger, nop_logger
+from ..p2p.mconn import ChannelDescriptor
+from ..p2p.switch import Reactor
+from ..p2p.transport import Peer
+from ..types.part_set import PartSet
+from ..types.vote import Vote, VoteType
+from .messages import (
+    BlockPartMessage,
+    HasVoteMessage,
+    NewRoundStepMessage,
+    NewValidBlockMessage,
+    ProposalMessage,
+    ProposalPOLMessage,
+    VoteMessage,
+    VoteSetBitsMessage,
+    VoteSetMaj23Message,
+    decode_msg,
+    encode_msg,
+)
+from .state_machine import (
+    EVENT_NEW_ROUND_STEP,
+    EVENT_PROPOSAL_BLOCK_PART,
+    EVENT_VALID_BLOCK,
+    EVENT_VOTE,
+    ConsensusState,
+    Step,
+)
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+GOSSIP_SLEEP = 0.05
+MAJ23_SLEEP = 2.0
+
+
+@dataclass
+class PeerRoundState:
+    """What we believe the peer's round state is
+    (reference consensus/types/peer_round_state.go)."""
+
+    height: int = 0
+    round: int = -1
+    step: int = 0
+    proposal: bool = False
+    proposal_block_psh = None
+    proposal_block_parts: Optional[BitArray] = None
+    proposal_pol_round: int = -1
+    proposal_pol: Optional[BitArray] = None
+    prevotes: dict[int, BitArray] = field(default_factory=dict)
+    precommits: dict[int, BitArray] = field(default_factory=dict)
+    last_commit_round: int = -1
+    last_commit: Optional[BitArray] = None
+    catchup_commit_round: int = -1
+    catchup_commit: Optional[BitArray] = None
+
+    def get_votes_bits(self, height: int, round_: int, vtype: int, size: int) -> BitArray:
+        if height == self.height:
+            table = self.prevotes if vtype == VoteType.PREVOTE else self.precommits
+            if round_ not in table:
+                table[round_] = BitArray(size)
+            return table[round_]
+        if height == self.height - 1 and vtype == VoteType.PRECOMMIT:
+            if self.last_commit is None or self.last_commit.size != size:
+                self.last_commit = BitArray(size)
+            return self.last_commit
+        return BitArray(size)
+
+    def set_has_vote(self, height: int, round_: int, vtype: int, index: int, size: int) -> None:
+        self.get_votes_bits(height, round_, vtype, size).set(index, True)
+
+    def apply_new_round_step(self, msg: NewRoundStepMessage) -> None:
+        if msg.height != self.height:
+            self.proposal = False
+            self.proposal_block_psh = None
+            self.proposal_block_parts = None
+            self.proposal_pol_round = -1
+            self.proposal_pol = None
+            self.prevotes = {}
+            self.precommits = {}
+            if msg.height == self.height + 1:
+                # our precommits become their last commit
+                self.last_commit_round = self.precommits and max(self.precommits) or -1
+            self.last_commit_round = msg.last_commit_round
+            self.last_commit = None
+        elif msg.round != self.round:
+            self.proposal = False
+            self.proposal_block_psh = None
+            self.proposal_block_parts = None
+            self.proposal_pol_round = -1
+            self.proposal_pol = None
+        self.height = msg.height
+        self.round = msg.round
+        self.step = msg.step
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, cs: ConsensusState, logger: Optional[Logger] = None):
+        super().__init__("consensus")
+        self.cs = cs
+        self.logger = logger or nop_logger()
+        self._peer_states: dict[str, PeerRoundState] = {}
+        self._peer_tasks: dict[str, list[asyncio.Task]] = {}
+        # fast-path: push our own messages + round steps
+        cs.event_switch.add_listener(
+            "reactor", EVENT_NEW_ROUND_STEP, self._on_new_round_step
+        )
+        cs.event_switch.add_listener("reactor", EVENT_VOTE, self._on_vote)
+        cs.event_switch.add_listener(
+            "reactor", EVENT_VALID_BLOCK, self._on_valid_block
+        )
+        cs.broadcast_hook = self._broadcast_own
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=6),
+            ChannelDescriptor(id=DATA_CHANNEL, priority=10),
+            ChannelDescriptor(id=VOTE_CHANNEL, priority=7),
+            ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=1),
+        ]
+
+    # --- event-switch fast path ------------------------------------------
+
+    def _on_new_round_step(self, rs) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(
+                STATE_CHANNEL, encode_msg(self._new_round_step_msg())
+            )
+
+    def _on_vote(self, vote: Vote) -> None:
+        # announce possession so peers stop sending it to us
+        if self.switch is not None:
+            msg = HasVoteMessage(
+                vote.height, vote.round, vote.type, vote.validator_index
+            )
+            self.switch.broadcast(STATE_CHANNEL, encode_msg(msg))
+
+    def _on_valid_block(self, rs) -> None:
+        if self.switch is not None and rs.proposal_block_parts is not None:
+            msg = NewValidBlockMessage(
+                rs.height,
+                rs.round,
+                rs.proposal_block_parts.header,
+                rs.proposal_block_parts.bit_array,
+                rs.step == Step.COMMIT,
+            )
+            self.switch.broadcast(STATE_CHANNEL, encode_msg(msg))
+
+    def _broadcast_own(self, msg) -> None:
+        if self.switch is None:
+            return
+        if isinstance(msg, (ProposalMessage, BlockPartMessage)):
+            self.switch.broadcast(DATA_CHANNEL, encode_msg(msg))
+        elif isinstance(msg, VoteMessage):
+            self.switch.broadcast(VOTE_CHANNEL, encode_msg(msg))
+
+    def _new_round_step_msg(self) -> NewRoundStepMessage:
+        rs = self.cs.rs
+        lcr = -1
+        if rs.last_commit is not None:
+            lcr = rs.last_commit.round
+        return NewRoundStepMessage(
+            height=rs.height,
+            round=rs.round,
+            step=int(rs.step),
+            seconds_since_start_time=max(
+                0, int((self.cs.now_ns() - rs.start_time_ns) / 1e9)
+            ),
+            last_commit_round=lcr,
+        )
+
+    # --- peer lifecycle ---------------------------------------------------
+
+    async def add_peer(self, peer: Peer) -> None:
+        prs = PeerRoundState()
+        self._peer_states[peer.id] = prs
+        loop = asyncio.get_running_loop()
+        self._peer_tasks[peer.id] = [
+            loop.create_task(self._gossip_data_routine(peer, prs)),
+            loop.create_task(self._gossip_votes_routine(peer, prs)),
+            loop.create_task(self._query_maj23_routine(peer, prs)),
+        ]
+        peer.send(STATE_CHANNEL, encode_msg(self._new_round_step_msg()))
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        for t in self._peer_tasks.pop(peer.id, []):
+            t.cancel()
+        self._peer_states.pop(peer.id, None)
+
+    # --- receive ----------------------------------------------------------
+
+    async def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        try:
+            msg = decode_msg(msg_bytes)
+        except ValueError as e:
+            await self.switch.stop_peer_for_error(peer, f"bad consensus msg: {e}")
+            return
+        prs = self._peer_states.get(peer.id)
+        if prs is None:
+            return
+        cs = self.cs
+        if channel_id == STATE_CHANNEL:
+            if isinstance(msg, NewRoundStepMessage):
+                prs.apply_new_round_step(msg)
+            elif isinstance(msg, NewValidBlockMessage):
+                if msg.height == prs.height:
+                    prs.proposal_block_psh = msg.block_part_set_header
+                    prs.proposal_block_parts = msg.block_parts
+            elif isinstance(msg, HasVoteMessage):
+                size = cs.state.validators.size()
+                prs.set_has_vote(msg.height, msg.round, msg.type, msg.index, size)
+            elif isinstance(msg, VoteSetMaj23Message):
+                if msg.height != cs.rs.height:
+                    return
+                try:
+                    cs.rs.votes.set_peer_maj23(
+                        msg.round, msg.type, peer.id, msg.block_id
+                    )
+                except ValueError:
+                    return
+                # respond with our vote bits for that blockID
+                vs = (
+                    cs.rs.votes.prevotes(msg.round)
+                    if msg.type == VoteType.PREVOTE
+                    else cs.rs.votes.precommits(msg.round)
+                )
+                if vs is not None:
+                    bits = vs.bit_array_by_block_id(msg.block_id)
+                    if bits is not None:
+                        peer.send(
+                            VOTE_SET_BITS_CHANNEL,
+                            encode_msg(
+                                VoteSetBitsMessage(
+                                    msg.height, msg.round, msg.type, msg.block_id, bits
+                                )
+                            ),
+                        )
+        elif channel_id == DATA_CHANNEL:
+            if isinstance(msg, ProposalMessage):
+                prs.proposal = True
+                if prs.proposal_block_parts is None:
+                    prs.proposal_block_psh = (
+                        msg.proposal.block_id.part_set_header
+                    )
+                    prs.proposal_block_parts = BitArray(
+                        msg.proposal.block_id.part_set_header.total
+                    )
+                prs.proposal_pol_round = msg.proposal.pol_round
+                await cs.add_proposal(msg.proposal, peer.id)
+            elif isinstance(msg, ProposalPOLMessage):
+                if msg.height == prs.height:
+                    prs.proposal_pol_round = msg.proposal_pol_round
+                    prs.proposal_pol = msg.proposal_pol
+            elif isinstance(msg, BlockPartMessage):
+                if prs.proposal_block_parts is not None:
+                    prs.proposal_block_parts.set(msg.part.index, True)
+                await cs.add_block_part(msg.height, msg.round, msg.part, peer.id)
+        elif channel_id == VOTE_CHANNEL:
+            if isinstance(msg, VoteMessage):
+                size = cs.state.validators.size()
+                prs.set_has_vote(
+                    msg.vote.height,
+                    msg.vote.round,
+                    msg.vote.type,
+                    msg.vote.validator_index,
+                    size,
+                )
+                await cs.add_vote(msg.vote, peer.id)
+        elif channel_id == VOTE_SET_BITS_CHANNEL:
+            if isinstance(msg, VoteSetBitsMessage) and msg.height == cs.rs.height:
+                vs = (
+                    cs.rs.votes.prevotes(msg.round)
+                    if msg.type == VoteType.PREVOTE
+                    else cs.rs.votes.precommits(msg.round)
+                )
+                if vs is not None:
+                    ours = vs.bit_array_by_block_id(msg.block_id)
+                    if ours is not None:
+                        # mark what the peer claims to have
+                        table = (
+                            prs.prevotes
+                            if msg.type == VoteType.PREVOTE
+                            else prs.precommits
+                        )
+                        table[msg.round] = msg.votes
+
+    # --- gossip routines --------------------------------------------------
+
+    async def _gossip_data_routine(self, peer: Peer, prs: PeerRoundState) -> None:
+        """reference gossipDataRoutine :531 + catchup :628."""
+        cs = self.cs
+        try:
+            while True:
+                rs = cs.rs
+                # 1. send proposal block parts the peer is missing
+                if (
+                    rs.height == prs.height
+                    and rs.proposal_block_parts is not None
+                    and prs.proposal_block_parts is not None
+                    and rs.proposal_block_parts.header == prs.proposal_block_psh
+                ):
+                    ours = rs.proposal_block_parts.bit_array
+                    missing = ours.sub(prs.proposal_block_parts)
+                    idx, ok = missing.pick_random()
+                    if ok:
+                        part = rs.proposal_block_parts.get_part(idx)
+                        if part is not None and peer.send(
+                            DATA_CHANNEL,
+                            encode_msg(
+                                BlockPartMessage(rs.height, rs.round, part)
+                            ),
+                        ):
+                            prs.proposal_block_parts.set(idx, True)
+                            continue
+                # 2. peer is on an older height: catch them up from the store
+                if (
+                    prs.height > 0
+                    and prs.height < rs.height
+                    and prs.height >= cs.block_store.base
+                ):
+                    await self._gossip_catchup(peer, prs)
+                    continue
+                # 3. send the proposal itself
+                if (
+                    rs.height == prs.height
+                    and rs.proposal is not None
+                    and not prs.proposal
+                ):
+                    if peer.send(
+                        DATA_CHANNEL, encode_msg(ProposalMessage(rs.proposal))
+                    ):
+                        prs.proposal = True
+                        if 0 <= rs.proposal.pol_round:
+                            pv = rs.votes.prevotes(rs.proposal.pol_round)
+                            if pv is not None:
+                                peer.send(
+                                    DATA_CHANNEL,
+                                    encode_msg(
+                                        ProposalPOLMessage(
+                                            rs.height,
+                                            rs.proposal.pol_round,
+                                            pv.bit_array(),
+                                        )
+                                    ),
+                                )
+                # ALWAYS yield: a failed send (full queue) must not spin
+                # the loop — one non-awaiting coroutine starves asyncio
+                await asyncio.sleep(GOSSIP_SLEEP)
+        except asyncio.CancelledError:
+            pass
+
+    async def _gossip_catchup(self, peer: Peer, prs: PeerRoundState) -> None:
+        """Send parts of the committed block at the peer's height."""
+        meta = self.cs.block_store.load_block_meta(prs.height)
+        if meta is None:
+            await asyncio.sleep(GOSSIP_SLEEP)
+            return
+        if (
+            prs.proposal_block_psh != meta.block_id.part_set_header
+            or prs.proposal_block_parts is None
+        ):
+            prs.proposal_block_psh = meta.block_id.part_set_header
+            prs.proposal_block_parts = BitArray(
+                meta.block_id.part_set_header.total
+            )
+        ours = BitArray.from_indices(
+            meta.block_id.part_set_header.total,
+            range(meta.block_id.part_set_header.total),
+        )
+        missing = ours.sub(prs.proposal_block_parts)
+        idx, ok = missing.pick_random()
+        if not ok:
+            await asyncio.sleep(GOSSIP_SLEEP)
+            return
+        part = self.cs.block_store.load_block_part(prs.height, idx)
+        if part is None:
+            await asyncio.sleep(GOSSIP_SLEEP)
+            return
+        if peer.send(
+            DATA_CHANNEL,
+            encode_msg(BlockPartMessage(prs.height, prs.round, part)),
+        ):
+            prs.proposal_block_parts.set(idx, True)
+
+    async def _gossip_votes_routine(self, peer: Peer, prs: PeerRoundState) -> None:
+        """reference gossipVotesRoutine :671: send one vote the peer lacks."""
+        cs = self.cs
+        try:
+            while True:
+                rs = cs.rs
+                sent = False
+                if rs.height == prs.height and rs.votes is not None:
+                    # current round prevotes + precommits, peer's POL round
+                    for vtype, vs in (
+                        (VoteType.PREVOTE, rs.votes.prevotes(prs.round)),
+                        (VoteType.PRECOMMIT, rs.votes.precommits(prs.round)),
+                    ):
+                        if vs is None:
+                            continue
+                        sent = self._pick_send_vote(peer, prs, vs)
+                        if sent:
+                            break
+                elif (
+                    rs.height == prs.height + 1
+                    and rs.last_commit is not None
+                ):
+                    # peer finishing the previous height: our last commit
+                    sent = self._pick_send_vote(peer, prs, rs.last_commit)
+                elif (
+                    prs.height > 0
+                    and prs.height < rs.height
+                    and prs.height >= cs.block_store.base
+                ):
+                    # deep catchup: the stored seen-commit for their height
+                    commit = cs.block_store.load_seen_commit(prs.height)
+                    if commit is not None:
+                        sent = self._send_commit_votes(peer, prs, commit)
+                if not sent:
+                    await asyncio.sleep(GOSSIP_SLEEP)
+        except asyncio.CancelledError:
+            pass
+
+    def _pick_send_vote(self, peer: Peer, prs: PeerRoundState, vote_set) -> bool:
+        ours = vote_set.bit_array()
+        theirs = prs.get_votes_bits(
+            vote_set.height, vote_set.round, vote_set.signed_msg_type, ours.size
+        )
+        missing = ours.sub(theirs)
+        idx, ok = missing.pick_random()
+        if not ok:
+            return False
+        vote = vote_set.get_by_index(idx)
+        if vote is None:
+            return False
+        if peer.send(VOTE_CHANNEL, encode_msg(VoteMessage(vote))):
+            theirs.set(idx, True)
+            return True
+        return False
+
+    def _send_commit_votes(self, peer: Peer, prs: PeerRoundState, commit) -> bool:
+        """Reconstruct precommit votes from a stored commit for catchup."""
+        from ..types.block import BlockIDFlag
+        from ..types.block_id import BlockID
+
+        theirs = prs.get_votes_bits(
+            commit.height, commit.round, VoteType.PRECOMMIT, commit.size()
+        )
+        for i, csig in enumerate(commit.signatures):
+            if csig.is_absent() or theirs.get(i):
+                continue
+            vote = Vote(
+                type=VoteType.PRECOMMIT,
+                height=commit.height,
+                round=commit.round,
+                block_id=(
+                    commit.block_id if csig.for_block() else BlockID()
+                ),
+                timestamp_ns=csig.timestamp_ns,
+                validator_address=csig.validator_address,
+                validator_index=i,
+                signature=csig.signature,
+                bls_signature=csig.bls_signature,
+            )
+            if peer.send(VOTE_CHANNEL, encode_msg(VoteMessage(vote))):
+                theirs.set(i, True)
+                return True
+        return False
+
+    async def _query_maj23_routine(self, peer: Peer, prs: PeerRoundState) -> None:
+        """reference queryMaj23Routine :804: periodically tell peers which
+        blocks we saw 2/3 for, so they can send us missing votes."""
+        cs = self.cs
+        try:
+            while True:
+                await asyncio.sleep(MAJ23_SLEEP)
+                rs = cs.rs
+                if rs.height != prs.height or rs.votes is None:
+                    continue
+                for vtype, vs in (
+                    (VoteType.PREVOTE, rs.votes.prevotes(rs.round)),
+                    (VoteType.PRECOMMIT, rs.votes.precommits(rs.round)),
+                ):
+                    if vs is None:
+                        continue
+                    bid, ok = vs.two_thirds_majority()
+                    if ok:
+                        peer.send(
+                            STATE_CHANNEL,
+                            encode_msg(
+                                VoteSetMaj23Message(
+                                    rs.height, rs.round, vtype, bid
+                                )
+                            ),
+                        )
+        except asyncio.CancelledError:
+            pass

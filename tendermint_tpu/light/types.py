@@ -1,0 +1,59 @@
+"""LightBlock — signed header + validator set (reference types/light.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Protocol, runtime_checkable
+
+from ..libs import protoio as pio
+from ..types.block import Commit, Header
+from ..types.validator_set import ValidatorSet
+
+
+@dataclass
+class LightBlock:
+    header: Header
+    commit: Commit
+    validators: ValidatorSet
+
+    @property
+    def height(self) -> int:
+        return self.header.height
+
+    def validate_basic(self, chain_id: str) -> None:
+        if self.header.chain_id != chain_id:
+            raise ValueError("light block from wrong chain")
+        self.commit.validate_basic()
+        if self.commit.height != self.header.height:
+            raise ValueError("commit height != header height")
+        if self.commit.block_id.hash != self.header.hash():
+            raise ValueError("commit is not for this header")
+        if self.header.validators_hash != self.validators.hash():
+            raise ValueError("validator set does not match header")
+
+    def encode(self) -> bytes:
+        return (
+            pio.field_message(1, self.header.encode())
+            + pio.field_message(2, self.commit.encode())
+            + pio.field_message(3, self.validators.encode())
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LightBlock":
+        f = pio.decode_fields(data)
+        return cls(
+            header=Header.decode(f[1][0]),
+            commit=Commit.decode(f[2][0]),
+            validators=ValidatorSet.decode(f[3][0]),
+        )
+
+
+@runtime_checkable
+class Provider(Protocol):
+    """Light block source (reference light/provider/provider.go)."""
+
+    async def light_block(self, height: int) -> Optional[LightBlock]:
+        """height=0 means latest. None if not found."""
+        ...
+
+    def id(self) -> str: ...

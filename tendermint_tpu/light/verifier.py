@@ -1,0 +1,115 @@
+"""Stateless light verification.
+
+Reference: light/verifier.go — VerifyAdjacent :93 (hash-chain via
+NextValidatorsHash :117) and VerifyNonAdjacent :32 (≥1/3 trusted overlap
+via VerifyCommitLightTrusting :58, then 2/3 of the new set :73). Both
+commit verifications run as single TPU batches (types/validator_set.py).
+"""
+
+from __future__ import annotations
+
+from ..types.block_id import BlockID
+from .types import LightBlock
+
+DEFAULT_MAX_CLOCK_DRIFT_NS = 10 * 1_000_000_000
+
+
+class VerificationError(Exception):
+    pass
+
+
+class ErrNewHeaderTooFarAhead(VerificationError):
+    """Non-adjacent verify failed the trust threshold — bisect."""
+
+
+def _common_checks(
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    trusting_period_ns: int,
+    now_ns: int,
+    max_clock_drift_ns: int,
+) -> None:
+    if untrusted.header.chain_id != trusted.header.chain_id:
+        raise VerificationError("chain id mismatch")
+    if untrusted.height <= trusted.height:
+        raise VerificationError("new header height must increase")
+    if trusted.header.time_ns + trusting_period_ns < now_ns:
+        raise VerificationError("trusted header expired (outside trusting period)")
+    if untrusted.header.time_ns <= trusted.header.time_ns:
+        raise VerificationError("new header time must be after trusted header")
+    if untrusted.header.time_ns > now_ns + max_clock_drift_ns:
+        raise VerificationError("new header is from the future")
+
+
+def verify_adjacent(
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    trusting_period_ns: int,
+    now_ns: int,
+    max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+) -> None:
+    """untrusted.height == trusted.height + 1 (reference :93)."""
+    if untrusted.height != trusted.height + 1:
+        raise VerificationError("headers must be adjacent")
+    _common_checks(trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns)
+    # the hash chain pins the next validator set (reference :117)
+    if untrusted.header.validators_hash != trusted.header.next_validators_hash:
+        raise VerificationError(
+            "untrusted validators hash != trusted next validators hash"
+        )
+    untrusted.validate_basic(trusted.header.chain_id)
+    _verify_commit_full_power(untrusted)
+
+
+def verify_non_adjacent(
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    trusting_period_ns: int,
+    now_ns: int,
+    trust_numerator: int = 1,
+    trust_denominator: int = 3,
+    max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+) -> None:
+    """Skipping verification (reference :32): enough of the OLD set still
+    signs the new header, and the new set has 2/3 on it."""
+    if untrusted.height == trusted.height + 1:
+        return verify_adjacent(
+            trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns
+        )
+    _common_checks(trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns)
+    untrusted.validate_basic(trusted.header.chain_id)
+    try:
+        trusted.validators.verify_commit_light_trusting(
+            trusted.header.chain_id,
+            untrusted.commit,
+            trust_numerator,
+            trust_denominator,
+        )
+    except ValueError as e:
+        raise ErrNewHeaderTooFarAhead(str(e)) from e
+    _verify_commit_full_power(untrusted)
+
+
+def _verify_commit_full_power(lb: LightBlock) -> None:
+    try:
+        lb.validators.verify_commit_light(
+            lb.header.chain_id,
+            BlockID(lb.header.hash(), lb.commit.block_id.part_set_header),
+            lb.height,
+            lb.commit,
+        )
+    except ValueError as e:
+        raise VerificationError(f"invalid commit: {e}") from e
+
+
+def verify(
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    trusting_period_ns: int,
+    now_ns: int,
+) -> None:
+    """Dispatch (reference Verify :135)."""
+    if untrusted.height == trusted.height + 1:
+        verify_adjacent(trusted, untrusted, trusting_period_ns, now_ns)
+    else:
+        verify_non_adjacent(trusted, untrusted, trusting_period_ns, now_ns)
