@@ -1,0 +1,338 @@
+"""BLS signature scheme over BLS12-381 — the fork's L2 dual-signing crypto.
+
+Mirrors the behavior of the reference's blssignatures package
+(/root/reference/blssignatures/bls_signatures.go):
+
+- secret keys: scalars mod r; public keys in G2 (pk = sk*G2gen);
+  signatures in G1 (sig = sk * H(m)).
+- H(m) = MapToCurve(16-byte padding || keccak256(m)); padding[0] = 1 in
+  key-validation mode for domain separation (bls_signatures.go:179-188).
+- proof-of-possession (Ristenpart–Yilek): the private key signs its own
+  serialized public key under the tweaked hash (bls_signatures.go:66-75).
+- verification: 2-pairing check e(H(m), pk) == e(sig, G2gen)
+  (bls_signatures.go:114-127), implemented as a single product
+  e(H(m), pk) * e(-sig, G2gen) == 1.
+- aggregation: point sums of keys (G2) / signatures (G1)
+  (bls_signatures.go:129-149); aggregate verification over distinct
+  messages does n+1 pairings (bls_signatures.go:151-171).
+- serialization: uncompressed big-endian — G1 96 bytes (x||y), G2 192
+  bytes (x.c1||x.c0||y.c1||y.c0); infinity encodes as zeros. Public keys
+  serialize as proof-length-prefixed proof+key (bls_signatures.go:195-213).
+
+Unlike the reference (which trusts kilic's FromBytes on-curve check only),
+deserialization here also subgroup-checks — defense in depth; documented
+divergence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+from dataclasses import dataclass
+
+from . import bls12_381 as c
+from .keccak import keccak256
+
+
+class BLSError(Exception):
+    pass
+
+
+# --- hash to curve --------------------------------------------------------
+
+
+def hash_to_g1(message: bytes, key_validation_mode: bool = False):
+    """16-byte padding || keccak256(msg), mapped to G1."""
+    padding = bytearray(16)
+    if key_validation_mode:
+        padding[0] = 1
+    return c.map_to_curve_g1(bytes(padding) + keccak256(message))
+
+
+# --- serialization --------------------------------------------------------
+
+
+def g1_to_bytes(p) -> bytes:
+    a = c.g1_to_affine(p)
+    if a is None:
+        return b"\x00" * 96
+    return a[0].to_bytes(48, "big") + a[1].to_bytes(48, "big")
+
+
+def g1_from_bytes(b: bytes):
+    if len(b) != 96:
+        raise BLSError("G1 encoding must be 96 bytes")
+    if b == b"\x00" * 96:
+        return c.G1_INF
+    x = int.from_bytes(b[:48], "big")
+    y = int.from_bytes(b[48:], "big")
+    if x >= c.P or y >= c.P:
+        raise BLSError("G1 coordinate out of range")
+    p = (x, y, 1)
+    if not c.g1_on_curve(p):
+        raise BLSError("G1 point not on curve")
+    if not c.g1_in_subgroup(p):
+        raise BLSError("G1 point not in the prime-order subgroup")
+    return p
+
+
+def g2_to_bytes(p) -> bytes:
+    a = c.g2_to_affine(p)
+    if a is None:
+        return b"\x00" * 192
+    (x0, x1), (y0, y1) = a
+    return (
+        x1.to_bytes(48, "big")
+        + x0.to_bytes(48, "big")
+        + y1.to_bytes(48, "big")
+        + y0.to_bytes(48, "big")
+    )
+
+
+def g2_from_bytes(b: bytes):
+    if len(b) != 192:
+        raise BLSError("G2 encoding must be 192 bytes")
+    if b == b"\x00" * 192:
+        return c.G2_INF
+    vals = [int.from_bytes(b[i * 48 : (i + 1) * 48], "big") for i in range(4)]
+    if any(v >= c.P for v in vals):
+        raise BLSError("G2 coordinate out of range")
+    x = (vals[1], vals[0])
+    y = (vals[3], vals[2])
+    p = (x, y, c.F2_ONE)
+    if not c.g2_on_curve(p):
+        raise BLSError("G2 point not on curve")
+    if not c.g2_in_subgroup(p):
+        raise BLSError("G2 point not in the prime-order subgroup")
+    return p
+
+
+# --- keys and signatures --------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PublicKey:
+    """G2 key + optional proof-of-possession (None => trusted source)."""
+
+    key: tuple
+    validity_proof: tuple | None = None
+
+    def to_trusted(self) -> "PublicKey":
+        return PublicKey(self.key, None)
+
+
+def generate_priv_key() -> int:
+    return secrets.randbelow(c.R - 1) + 1
+
+
+def pubkey_from_priv(priv: int) -> PublicKey:
+    key = c.g2_mul(c.G2_GEN, priv)
+    proof = key_validity_proof(key, priv)
+    pub = new_public_key(key, proof)
+    return pub
+
+
+def key_validity_proof(key, priv: int):
+    """PoP: sign the serialized public key in key-validation mode."""
+    return _sign2(priv, g2_to_bytes(key), key_validation_mode=True)
+
+
+def new_public_key(key, validity_proof) -> PublicKey:
+    pub = PublicKey(key, validity_proof)
+    if not _verify2(validity_proof, g2_to_bytes(key), pub, key_validation_mode=True):
+        raise BLSError("public key validation failed")
+    return pub
+
+
+def new_trusted_public_key(key) -> PublicKey:
+    return PublicKey(key, None)
+
+
+def sign(priv: int, message: bytes):
+    """Signature = priv * H(message) in G1."""
+    return _sign2(priv, message, key_validation_mode=False)
+
+
+def _sign2(priv: int, message: bytes, key_validation_mode: bool):
+    h = hash_to_g1(message, key_validation_mode)
+    return c.g1_mul(h, priv)
+
+
+def verify(sig, message: bytes, pub: PublicKey) -> bool:
+    return _verify2(sig, message, pub, key_validation_mode=False)
+
+
+def _verify2(sig, message: bytes, pub: PublicKey, key_validation_mode: bool) -> bool:
+    h = hash_to_g1(message, key_validation_mode)
+    # e(H, pk) == e(sig, G2gen)  <=>  e(H, pk) * e(-sig, G2gen) == 1
+    return c.multi_pairing_is_one(
+        [(h, pub.key), (c.g1_neg(sig), c.G2_GEN)]
+    )
+
+
+def aggregate_public_keys(pubs: list[PublicKey]) -> PublicKey:
+    acc = c.G2_INF
+    for pk in pubs:
+        acc = c.g2_add(acc, pk.key)
+    return new_trusted_public_key(acc)
+
+
+def aggregate_signatures(sigs: list):
+    acc = c.G1_INF
+    for s in sigs:
+        acc = c.g1_add(acc, s)
+    return acc
+
+
+def verify_aggregated_same_message(sig, message: bytes, pubs: list[PublicKey]) -> bool:
+    return verify(sig, message, aggregate_public_keys(pubs))
+
+
+def verify_aggregated_different_messages(
+    sig, messages: list[bytes], pubs: list[PublicKey]
+) -> bool:
+    """n+1 pairings: prod e(H(m_i), pk_i) * e(-sig, G2gen) == 1
+    (bls_signatures.go:151-171)."""
+    if len(messages) != len(pubs):
+        raise BLSError("len(messages) != len(pub keys)")
+    pairs = [
+        (hash_to_g1(m, False), pk.key) for m, pk in zip(messages, pubs)
+    ]
+    pairs.append((c.g1_neg(sig), c.G2_GEN))
+    return c.multi_pairing_is_one(pairs)
+
+
+# --- byte-level public key (proof-prefixed, bls_signatures.go:195-258) ----
+
+
+def public_key_to_bytes(pub: PublicKey) -> bytes:
+    key_bytes = g2_to_bytes(pub.key)
+    if pub.validity_proof is None:
+        return b"\x00" + key_bytes
+    sig_bytes = g1_to_bytes(pub.validity_proof)
+    if len(sig_bytes) > 255:
+        raise BLSError("validity proof too large to serialize")
+    return bytes([len(sig_bytes)]) + sig_bytes + key_bytes
+
+
+def public_key_from_bytes(data: bytes, trusted_source: bool) -> PublicKey:
+    if not data:
+        raise BLSError("tried to deserialize empty public key")
+    proof_len = data[0]
+    if proof_len == 0:
+        if not trusted_source:
+            raise BLSError(
+                "tried to deserialize unvalidated public key from untrusted source"
+            )
+        return new_trusted_public_key(g2_from_bytes(data[1:]))
+    if len(data) < 1 + proof_len:
+        raise BLSError("invalid serialized public key")
+    proof = g1_from_bytes(data[1 : 1 + proof_len])
+    key = g2_from_bytes(data[1 + proof_len :])
+    if trusted_source:
+        return PublicKey(key, proof)
+    return new_public_key(key, proof)
+
+
+def priv_key_to_bytes(priv: int) -> bytes:
+    # big.Int.Bytes() semantics: minimal big-endian, empty for zero
+    n = (priv.bit_length() + 7) // 8
+    return priv.to_bytes(n, "big")
+
+
+def priv_key_from_bytes(data: bytes) -> int:
+    return int.from_bytes(data, "big")
+
+
+# --- key file (blssignatures/file.go) -------------------------------------
+
+
+@dataclass
+class FileBLSKey:
+    pub_key: bytes
+    priv_key: bytes
+
+    def save(self, file_path: str) -> None:
+        if not file_path:
+            raise BLSError("cannot save bls key: filePath not set")
+        data = json.dumps(
+            {
+                "pub_key": self.pub_key.hex(),
+                "priv_key": self.priv_key.hex(),
+            },
+            indent=2,
+        )
+        tmp = file_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, file_path)
+
+
+def gen_file_bls_key() -> FileBLSKey:
+    priv = generate_priv_key()
+    pub = pubkey_from_priv(priv)
+    return FileBLSKey(
+        pub_key=public_key_to_bytes(pub), priv_key=priv_key_to_bytes(priv)
+    )
+
+
+def load_bls_key(file_path: str) -> FileBLSKey:
+    with open(file_path) as f:
+        d = json.load(f)
+    return FileBLSKey(
+        pub_key=bytes.fromhex(d["pub_key"]), priv_key=bytes.fromhex(d["priv_key"])
+    )
+
+
+def load_or_gen_bls_key(file_path: str) -> FileBLSKey:
+    if os.path.exists(file_path):
+        return load_bls_key(file_path)
+    k = gen_file_bls_key()
+    k.save(file_path)
+    return k
+
+
+# --- consensus integration helpers ----------------------------------------
+
+
+def signer_for(priv: int):
+    """bls_signer callable for ConsensusState: batch_hash -> 96-byte G1 sig
+    (the reference signs the raw BatchHash bytes — consensus/state.go:2560)."""
+
+    def _sign(batch_hash: bytes) -> bytes:
+        return g1_to_bytes(sign(priv, batch_hash))
+
+    return _sign
+
+
+class BLSKeyRegistry:
+    """tm-validator-pubkey -> BLS public key mapping.
+
+    Stands in for the L2 node's on-chain sequencer-set registry that backs
+    l2Node.VerifySignature (the real Morph node resolves the tm key to a
+    staked BLS key; reference call site consensus/state.go:2362-2379).
+    """
+
+    def __init__(self) -> None:
+        self._by_tm: dict[bytes, PublicKey] = {}
+
+    def register(self, tm_pubkey: bytes, pub: PublicKey) -> None:
+        self._by_tm[bytes(tm_pubkey)] = pub
+
+    def verifier(self):
+        """(tm_pubkey, message, sig_bytes) -> bool, for MockL2Node."""
+
+        def _verify(tm_pubkey: bytes, message: bytes, sig_bytes: bytes) -> bool:
+            pub = self._by_tm.get(bytes(tm_pubkey))
+            if pub is None:
+                return False
+            try:
+                s = g1_from_bytes(bytes(sig_bytes))
+            except BLSError:
+                return False
+            return verify(s, bytes(message), pub)
+
+        return _verify

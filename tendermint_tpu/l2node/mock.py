@@ -80,7 +80,10 @@ class MockL2Node:
     def verify_signature(self, tm_pubkey, message_hash, signature) -> bool:
         if self._bls_verifier is not None:
             return self._bls_verifier(tm_pubkey, message_hash, signature)
-        return True  # BLS disabled in this mock configuration
+        # No registry configured: reject. (A batch-point flow without BLS
+        # keys is a misconfiguration — never silently accept; see
+        # crypto/bls_signatures.BLSKeyRegistry for the real wiring.)
+        return False
 
     def append_bls_data(self, height, batch_hash, data: BlsData) -> None:
         with self._lock:

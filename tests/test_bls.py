@@ -1,0 +1,206 @@
+"""BLS12-381 curve library + signature scheme tests.
+
+No external vectors are reachable in this environment, so correctness rests
+on algebraic invariants (bilinearity, group orders, subgroup membership of
+hash outputs) plus scheme-level roundtrips mirroring the reference's test
+shape (/root/reference/blssignatures/bls_signatures_test.go: sign/verify,
+aggregate same/different messages, PoP, serialization roundtrips).
+"""
+
+import random
+
+import pytest
+
+from tendermint_tpu.crypto import bls12_381 as c
+from tendermint_tpu.crypto import bls_signatures as bls
+from tendermint_tpu.crypto.keccak import keccak256
+
+
+# --- keccak ---------------------------------------------------------------
+
+
+def test_keccak_known_vectors():
+    # ERC-20 selectors/topics — globally pinned constants
+    assert keccak256(b"transfer(address,uint256)")[:4].hex() == "a9059cbb"
+    assert keccak256(b"balanceOf(address)")[:4].hex() == "70a08231"
+    assert (
+        keccak256(b"Transfer(address,address,uint256)").hex()
+        == "ddf252ad1be2c89b69c2b068fc378daa952ba7f163c4a11628f55a4df523b3ef"
+    )
+
+
+def test_keccak_multiblock():
+    # > one rate block (136 bytes)
+    out = keccak256(b"a" * 300)
+    assert len(out) == 32
+    assert out != keccak256(b"a" * 299)
+
+
+# --- curve layer ----------------------------------------------------------
+
+
+def test_generators_have_order_r():
+    assert c.g1_on_curve(c.G1_GEN)
+    assert c.g2_on_curve(c.G2_GEN)
+    assert c.g1_is_inf(c.g1_mul_raw(c.G1_GEN, c.R))
+    assert c.g2_is_inf(c.g2_mul_raw(c.G2_GEN, c.R))
+
+
+def test_g1_group_law():
+    p2 = c.g1_add(c.G1_GEN, c.G1_GEN)
+    assert c.g1_eq(p2, c.g1_double(c.G1_GEN))
+    assert c.g1_eq(c.g1_mul(c.G1_GEN, 5), c.g1_add(p2, c.g1_add(p2, c.G1_GEN)))
+    assert c.g1_is_inf(c.g1_add(c.G1_GEN, c.g1_neg(c.G1_GEN)))
+
+
+def test_f12_inverse_and_frobenius():
+    random.seed(7)
+    a = tuple((random.randrange(c.P), random.randrange(c.P)) for _ in range(6))
+    assert c.f12_eq(c.f12_mul(a, c.f12_inv(a)), c.F12_ONE)
+    x = a
+    for _ in range(12):
+        x = c.f12_frob(x)
+    assert c.f12_eq(x, a)
+
+
+def test_pairing_bilinear():
+    e1 = c.pairing(c.G1_GEN, c.G2_GEN)
+    assert not c.f12_eq(e1, c.F12_ONE)
+
+    def f12_pow(x, e):
+        r = c.F12_ONE
+        while e:
+            if e & 1:
+                r = c.f12_mul(r, x)
+            x = c.f12_sqr(x)
+            e >>= 1
+        return r
+
+    a, b = 31337, 271828
+    eab = c.pairing(c.g1_mul(c.G1_GEN, a), c.g2_mul(c.G2_GEN, b))
+    assert c.f12_eq(eab, f12_pow(e1, a * b))
+    assert c.f12_eq(f12_pow(e1, c.R), c.F12_ONE)
+
+
+def test_hash_to_g1_subgroup():
+    for m in (b"", b"batch-hash", b"x" * 100):
+        p = bls.hash_to_g1(m)
+        assert c.g1_on_curve(p)
+        assert c.g1_in_subgroup(p)
+    # domain separation: key-validation hash differs
+    assert not c.g1_eq(bls.hash_to_g1(b"m"), bls.hash_to_g1(b"m", True))
+
+
+# --- scheme ---------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    priv = 0x1234567890ABCDEF_FEDCBA0987654321 % c.R
+    return priv, bls.pubkey_from_priv(priv)
+
+
+def test_sign_verify(keypair):
+    priv, pub = keypair
+    sig = bls.sign(priv, b"the batch hash")
+    assert bls.verify(sig, b"the batch hash", pub)
+    assert not bls.verify(sig, b"another message", pub)
+
+
+def test_flipped_byte_rejected(keypair):
+    """VERDICT round-1 item 3's 'done' criterion at the crypto layer."""
+    priv, pub = keypair
+    sig = bls.sign(priv, b"msg")
+    raw = bytearray(bls.g1_to_bytes(sig))
+    raw[5] ^= 1
+    try:
+        bad = bls.g1_from_bytes(bytes(raw))
+    except bls.BLSError:
+        return  # off-curve: rejected at decode — also a pass
+    assert not bls.verify(bad, b"msg", pub)
+
+
+def test_proof_of_possession(keypair):
+    priv, pub = keypair
+    assert pub.validity_proof is not None
+    # a proof for a different key must not validate
+    other = bls.pubkey_from_priv(99991)
+    with pytest.raises(bls.BLSError):
+        bls.new_public_key(pub.key, other.validity_proof)
+
+
+def test_aggregate_same_message():
+    privs = [11111 + i for i in range(4)]
+    pubs = [bls.pubkey_from_priv(s) for s in privs]
+    msg = b"common batch hash"
+    agg = bls.aggregate_signatures([bls.sign(s, msg) for s in privs])
+    assert bls.verify_aggregated_same_message(agg, msg, pubs)
+    assert not bls.verify_aggregated_same_message(agg, b"other", pubs)
+
+
+def test_aggregate_different_messages():
+    privs = [22222 + i for i in range(3)]
+    pubs = [bls.pubkey_from_priv(s) for s in privs]
+    msgs = [b"m1", b"m2", b"m3"]
+    agg = bls.aggregate_signatures(
+        [bls.sign(s, m) for s, m in zip(privs, msgs)]
+    )
+    assert bls.verify_aggregated_different_messages(agg, msgs, pubs)
+    assert not bls.verify_aggregated_different_messages(
+        agg, [b"m1", b"m2", b"WRONG"], pubs
+    )
+    with pytest.raises(bls.BLSError):
+        bls.verify_aggregated_different_messages(agg, msgs[:2], pubs)
+
+
+def test_serialization_roundtrips(keypair):
+    priv, pub = keypair
+    sig = bls.sign(priv, b"ser")
+    assert bls.g1_from_bytes(bls.g1_to_bytes(sig)) == c.g1_to_affine(sig) + (1,)
+    b2 = bls.g2_to_bytes(pub.key)
+    assert c.g2_eq(bls.g2_from_bytes(b2), pub.key)
+    # proof-prefixed public key bytes
+    pb = bls.public_key_to_bytes(pub)
+    back = bls.public_key_from_bytes(pb, trusted_source=False)
+    assert c.g2_eq(back.key, pub.key)
+    # trusted form (no proof)
+    tb = bls.public_key_to_bytes(pub.to_trusted())
+    assert tb[0] == 0
+    with pytest.raises(bls.BLSError):
+        bls.public_key_from_bytes(tb, trusted_source=False)
+    assert c.g2_eq(bls.public_key_from_bytes(tb, True).key, pub.key)
+    # priv key bytes
+    assert bls.priv_key_from_bytes(bls.priv_key_to_bytes(priv)) == priv
+
+
+def test_infinity_encodings():
+    assert bls.g1_to_bytes(c.G1_INF) == b"\x00" * 96
+    assert c.g1_is_inf(bls.g1_from_bytes(b"\x00" * 96))
+    assert bls.g2_to_bytes(c.G2_INF) == b"\x00" * 192
+
+
+def test_non_subgroup_point_rejected():
+    # find an on-curve G1 point NOT in the r-subgroup (cofactor > 1)
+    x = 3
+    while True:
+        rhs = (x * x * x + 4) % c.P
+        y = pow(rhs, (c.P + 1) // 4, c.P)
+        if y * y % c.P == rhs:
+            pt = (x, y, 1)
+            if not c.g1_in_subgroup(pt):
+                break
+        x += 1
+    raw = x.to_bytes(48, "big") + y.to_bytes(48, "big")
+    with pytest.raises(bls.BLSError):
+        bls.g1_from_bytes(raw)
+
+
+def test_key_file_roundtrip(tmp_path):
+    path = str(tmp_path / "bls_key.json")
+    k = bls.load_or_gen_bls_key(path)
+    k2 = bls.load_or_gen_bls_key(path)
+    assert k.priv_key == k2.priv_key and k.pub_key == k2.pub_key
+    priv = bls.priv_key_from_bytes(k.priv_key)
+    pub = bls.public_key_from_bytes(k.pub_key, trusted_source=False)
+    sig = bls.sign(priv, b"from file")
+    assert bls.verify(sig, b"from file", pub)

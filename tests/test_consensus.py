@@ -146,21 +146,35 @@ def test_net_survives_one_faulty_node():
     asyncio.run(run())
 
 
+def _bls_setup(pvs):
+    """Real BLS keys per validator + registry-backed verifier."""
+    from tendermint_tpu.crypto import bls_signatures as bls
+
+    registry = bls.BLSKeyRegistry()
+    signers = []
+    for i, pv in enumerate(pvs):
+        priv = 7919 + i  # deterministic test keys
+        pub = bls.pubkey_from_priv(priv)
+        registry.register(pv.get_pub_key().data, pub)
+        signers.append(bls.signer_for(priv))
+    return registry, signers
+
+
 def test_batch_point_bls_flow():
     """Every 2nd block is a batch point: header carries the batch hash,
-    precommits carry BLS signatures, the L2 node receives CommitBatch with
-    the aggregated BLS data (morph capability, SURVEY.md delta 2)."""
+    precommits carry REAL BLS12-381 signatures over it, the L2 node
+    verifies each one (2-pairing check) and receives CommitBatch with the
+    aggregated BLS data (morph capability, SURVEY.md delta 2)."""
+    from tendermint_tpu.crypto import bls_signatures as bls
+
     vs, pvs = make_validators(1)
     genesis = make_genesis(vs)
-    l2 = MockL2Node(batch_blocks_interval=2)
+    registry, signers = _bls_setup(pvs)
+    l2 = MockL2Node(batch_blocks_interval=2, bls_verifier=registry.verifier())
 
     async def run():
         cs, app, l2_, bs, ss = make_node(
-            vs,
-            pvs[0],
-            genesis,
-            l2=l2,
-            bls_signer=lambda batch_hash: b"bls:" + batch_hash[:28],
+            vs, pvs[0], genesis, l2=l2, bls_signer=signers[0]
         )
         await cs.start()
         await cs.wait_for_height(4, timeout=30)
@@ -173,10 +187,53 @@ def test_batch_point_bls_flow():
         assert batch_blocks, "no batch points produced"
         assert l2.committed_batches, "no batches committed to L2"
         batch_hash, bls_datas = l2.committed_batches[0]
-        assert bls_datas and bls_datas[0].signature.startswith(b"bls:")
+        assert bls_datas, "no BLS data in committed batch"
         assert l2.bls_appended  # AppendBlsData was called per precommit
         # the batch-point block's data carries the sealed batch header
         assert batch_blocks[0].data.l2_batch_header
+
+        # the committed signatures are genuine: they verify against the
+        # registered keys over the batch hash, and a flipped byte fails
+        pub = bls.public_key_from_bytes(
+            bls.public_key_to_bytes(bls.pubkey_from_priv(7919)), True
+        )
+        sig_bytes = bls_datas[0].signature
+        sig = bls.g1_from_bytes(sig_bytes)
+        assert bls.verify(sig, batch_hash, pub)
+        bad = bytearray(sig_bytes)
+        bad[7] ^= 1
+        assert not registry.verifier()(
+            pvs[0].get_pub_key().data, batch_hash, bytes(bad)
+        ), "flipped BLS byte must not verify"
+
+    asyncio.run(run())
+
+
+def test_batch_point_rejects_invalid_bls():
+    """A vote whose BLS signature doesn't verify is rejected at the batch
+    point (state_machine addVote BLS path; ref consensus/state.go:2362-2379)."""
+    from tendermint_tpu.crypto import bls_signatures as bls
+
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(pvs and vs)
+    registry, signers = _bls_setup(pvs)
+    l2 = MockL2Node(batch_blocks_interval=1, bls_verifier=registry.verifier())
+
+    async def run():
+        # signer produces garbage BLS bytes -> the node's own precommit is
+        # rejected at the batch point and the chain cannot commit height 1
+        cs, app, l2_, bs, ss = make_node(
+            vs,
+            pvs[0],
+            genesis,
+            l2=l2,
+            bls_signer=lambda bh: b"\x01" * 96,
+        )
+        await cs.start()
+        with pytest.raises(asyncio.TimeoutError):
+            await cs.wait_for_height(1, timeout=1.5)
+        await cs.stop()
+        assert not l2.committed_batches
 
     asyncio.run(run())
 

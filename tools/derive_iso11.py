@@ -1,0 +1,453 @@
+"""Derive the 11-isogeny kernel polynomial for BLS12-381 G1 SSWU hash-to-curve.
+
+The SSWU map (draft-irtf-cfrg-hash-to-curve-06 §6.6.2 — the variant the
+reference consumes through go-ethereum's bls12381.MapToCurve, see
+/root/reference/blssignatures/bls_signatures.go:179-188) targets an
+11-isogenous curve E': y^2 = x^3 + A'x + B' (simplified SWU needs a*b != 0,
+and E: y^2 = x^3 + 4 has a = 0), then carries the point to E through an
+11-isogeny. Public implementations bake the isogeny's rational-map
+coefficient tables; with no network egress we derive the isogeny from first
+principles instead:
+
+ 1. compute the 11-division polynomial psi_11 of E' (degree 60) by the
+    standard recurrences, working in the ring Fp[x,y]/(y^2 - x^3 - A'x - B')
+    so no manual y-parity bookkeeping is needed,
+ 2. find its irreducible factors of degree <= 5 over Fp (distinct-degree
+    factorization with Frobenius powers composed via modular composition;
+    Cantor-Zassenhaus for equal-degree splits),
+ 3. enumerate monic degree-5 products (a rational 11-isogeny kernel
+    polynomial has degree (11-1)/2 = 5 and divides psi_11),
+ 4. apply Velu's formulas (via power sums of the kernel roots and Newton's
+    identities) and keep the kernel whose image curve is exactly
+    y^2 = x^3 + 4, i.e. E.
+
+The winning h(x) coefficients are baked into crypto/bls12_381.py. At
+runtime the isogeny maps are *evaluated* through h alone:
+
+    T(x)   = sum t_Q/(x-x_Q)      -> expressible via h'/h and power sums
+    U(x)   = sum u_Q/(x-x_Q)
+    X(x)   = x + T(x) - U'(x)     (Velu x-map)
+    Y(x,y) = y * X'(x)            (Velu y-map for normalized isogenies)
+
+so no coefficient tables are required at all.
+
+Run:  python tools/derive_iso11.py     (~2-4 min of pure-Python bigints)
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+# SSWU iso-curve constants for BLS12-381 G1 (hash-to-curve draft, §8.8.1):
+A_ISO = 0x144698A3B8E9433D693A02C96D4982B0EA985383EE66A8D8E8981AEFD881AC98936F8DA0E0F97F5CF428082D584C1D
+B_ISO = 0x12E2908D11688030018B12E8753EEE3B2016C1F0F24F4070A0B9C14FCEF35EF55A23215A316CEAA5D1CC48E98E172BE0
+
+A_E, B_E = 0, 4  # the target curve E
+
+
+# --- dense polynomials over Fp: lists of ints, low -> high ----------------
+
+def ptrim(a):
+    while a and a[-1] == 0:
+        a.pop()
+    return a
+
+
+def padd(a, b):
+    n = max(len(a), len(b))
+    return ptrim(
+        [((a[i] if i < len(a) else 0) + (b[i] if i < len(b) else 0)) % P for i in range(n)]
+    )
+
+
+def psub(a, b):
+    n = max(len(a), len(b))
+    return ptrim(
+        [((a[i] if i < len(a) else 0) - (b[i] if i < len(b) else 0)) % P for i in range(n)]
+    )
+
+
+def pmul(a, b):
+    if not a or not b:
+        return []
+    out = [0] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        if ai:
+            for j, bj in enumerate(b):
+                out[i + j] += ai * bj
+    return ptrim([c % P for c in out])
+
+
+def pscale(a, k):
+    k %= P
+    return ptrim([ai * k % P for ai in a])
+
+
+def pdivmod(a, m):
+    a = list(a)
+    dm = len(m) - 1
+    q = [0] * max(1, len(a) - dm)
+    inv_lead = pow(m[-1], P - 2, P)
+    while a and len(a) - 1 >= dm:
+        k = len(a) - 1 - dm
+        c = a[-1] * inv_lead % P
+        q[k] = c
+        for i, mi in enumerate(m):
+            a[k + i] = (a[k + i] - c * mi) % P
+        ptrim(a)
+    return ptrim(q), a
+
+
+def pmod(a, m):
+    return pdivmod(a, m)[1]
+
+
+def pmonic(a):
+    return pscale(a, pow(a[-1], P - 2, P)) if a else a
+
+
+def pgcd(a, b):
+    a, b = list(a), list(b)
+    while b:
+        a, b = b, pmod(a, b)
+    return pmonic(a)
+
+
+def ppowmod(base, e, m):
+    r = [1]
+    b = pmod(base, m)
+    while e:
+        if e & 1:
+            r = pmod(pmul(r, b), m)
+        b = pmod(pmul(b, b), m)
+        e >>= 1
+    return r
+
+
+def pcompose_mod(g, f, m):
+    """g(f) mod m via Horner."""
+    r = []
+    for c in reversed(g):
+        r = pmod(padd(pmul(r, f), [c]), m)
+    return r
+
+
+def pderiv(a):
+    return ptrim([a[i] * i % P for i in range(1, len(a))])
+
+
+# --- ring Fp[x,y]/(y^2 - B(x)) as pairs (p0, p1) = p0 + y*p1 --------------
+
+class RB:
+    __slots__ = ("p0", "p1")
+
+    def __init__(self, p0=None, p1=None):
+        self.p0 = p0 or []
+        self.p1 = p1 or []
+
+    def __mul__(self, other):
+        p0 = padd(pmul(self.p0, other.p0), pmul(CURVE_B, pmul(self.p1, other.p1)))
+        p1 = padd(pmul(self.p0, other.p1), pmul(self.p1, other.p0))
+        return RB(p0, p1)
+
+    def __sub__(self, other):
+        return RB(psub(self.p0, other.p0), psub(self.p1, other.p1))
+
+    def pow3(self):
+        return self * self * self
+
+    def sq(self):
+        return self * self
+
+
+CURVE_B: list = []  # set in main(): x^3 + a x + b
+
+
+def division_psi(n, memo, a, b):
+    if n in memo:
+        return memo[n]
+    assert n >= 5
+    m = n // 2
+    if n % 2 == 1:
+        r = division_psi(m + 2, memo, a, b) * division_psi(m, memo, a, b).pow3() - division_psi(
+            m - 1, memo, a, b
+        ) * division_psi(m + 1, memo, a, b).pow3()
+    else:
+        inner = division_psi(m + 2, memo, a, b) * division_psi(m - 1, memo, a, b).sq() - division_psi(
+            m - 2, memo, a, b
+        ) * division_psi(m + 1, memo, a, b).sq()
+        prod = division_psi(m, memo, a, b) * inner
+        # psi_even = y*g; psi_m * inner == 2y * psi_{2m} => prod = 2*B(x)*g
+        assert not prod.p0 or not prod.p1, "expected homogeneous y-part"
+        if prod.p1:
+            # prod = y * q  =>  psi_2m = q / 2
+            r = RB([], pscale(prod.p1, pow(2, P - 2, P)))
+            # ... but psi_2m must be y*g with g = q/(2) / ... check: prod = 2y psi_2m
+            # prod = y*q -> psi_2m = q/(2) as coefficient of... prod=2y*(y g)=2Bg pure.
+            raise AssertionError("even psi product should be pure x-part")
+        q, rem = pdivmod(prod.p0, CURVE_B)
+        assert not rem, "psi even: division by B(x) must be exact"
+        r = RB([], pscale(q, pow(2, P - 2, P)))
+    memo[n] = r
+    return r
+
+
+def equal_degree_split(f, d):
+    """Cantor-Zassenhaus: split monic squarefree f (all factors degree d)."""
+    out = [f]
+    done = []
+    while out:
+        g = out.pop()
+        if len(g) - 1 == d:
+            done.append(g)
+            continue
+        while True:
+            r = ptrim([random.randrange(P) for _ in range(len(g) - 1)])
+            e = (P**d - 1) // 2
+            t = psub(ppowmod(r, e, g), [1])
+            h = pgcd(t, g)
+            if 0 < len(h) - 1 < len(g) - 1:
+                q, rem = pdivmod(g, h)
+                assert not rem
+                out.append(pmonic(h))
+                out.append(pmonic(q))
+                break
+    return done
+
+
+def power_sums(h, k):
+    """First k power sums of the roots of monic h via Newton's identities."""
+    d = len(h) - 1
+    e = [1] + [0] * d
+    for i in range(1, d + 1):
+        e[i] = (-1) ** i * h[d - i] % P
+    p = [d % P]
+    for kk in range(1, k + 1):
+        s = 0
+        for i in range(1, min(kk, d) + 1):
+            s += (-1) ** (i - 1) * e[i] * (p[kk - i] if kk - i > 0 else 1)
+        if kk <= d:
+            # p_k = e1 p_{k-1} - e2 p_{k-2} + ... + (-1)^{k-1} k e_k
+            s = 0
+            for i in range(1, kk):
+                s += (-1) ** (i - 1) * e[i] * p[kk - i]
+            s += (-1) ** (kk - 1) * kk * e[kk]
+        else:
+            s = 0
+            for i in range(1, d + 1):
+                s += (-1) ** (i - 1) * e[i] * p[kk - i]
+        p.append(s % P)
+    return p
+
+
+def velu_image(a, b, h):
+    """Velu image curve (A,B) for kernel polynomial h on y^2=x^3+ax+b."""
+    d = len(h) - 1
+    p = power_sums(h, 3)
+    p1, p2, p3 = p[1], p[2], p[3]
+    t = (6 * p2 + 2 * a * d) % P
+    w = (10 * p3 + 6 * a * p1 + 4 * b * d) % P
+    return (a - 5 * t) % P, (b - 7 * w) % P
+
+
+def main():
+    global CURVE_B
+    a, b = A_ISO, B_ISO
+    CURVE_B = ptrim([b % P, a % P, 0, 1])
+
+    memo = {
+        0: RB([], []),
+        1: RB([1], []),
+        2: RB([], [2]),
+        3: RB(ptrim([(-a * a) % P, 12 * b % P, 6 * a % P, 0, 3]), []),
+        4: RB(
+            [],
+            pscale(
+                ptrim(
+                    [
+                        (-8 * b * b - a**3) % P,
+                        (-4 * a * b) % P,
+                        (-5 * a * a) % P,
+                        20 * b % P,
+                        5 * a % P,
+                        0,
+                        1,
+                    ]
+                ),
+                4,
+            ),
+        ),
+    }
+    print("computing psi_11 ...")
+    psi11 = division_psi(11, memo, a, b)
+    assert not psi11.p1, "odd division polynomial must be pure in x"
+    f = pmonic(psi11.p0)
+    print("deg psi_11 =", len(f) - 1)
+    assert len(f) - 1 == 60
+
+    print("distinct-degree factorization (degrees 1..5) ...")
+    frob = ppowmod([0, 1], P, f)  # x^p mod f
+    fk = frob
+    remaining = f
+    small_factors = []  # (degree, irreducible factor)
+    for d in range(1, 6):
+        g = pgcd(psub(fk, [0, 1]), remaining)
+        if len(g) - 1 > 0:
+            print(f"  product of degree-{d} irreducibles: total degree {len(g)-1}")
+            irr = equal_degree_split(g, d) if len(g) - 1 > d else [pmonic(g)]
+            small_factors.extend((d, x) for x in irr)
+            remaining, rem = pdivmod(remaining, g)
+            assert not rem
+        if d < 5:
+            fk = pcompose_mod(fk, frob, f)  # x^(p^(d+1)) = (x^(p^d)) o (x^p)
+    print(f"  irreducible factors of degree<=5: {[(d, len(x)-1) for d, x in small_factors]}")
+
+    # enumerate monic products with total degree 5
+    found = None
+    idxs = range(len(small_factors))
+    for rsize in range(1, 6):
+        for combo in itertools.combinations(idxs, rsize):
+            if sum(small_factors[i][0] for i in combo) != 5:
+                continue
+            h = [1]
+            for i in combo:
+                h = pmul(h, small_factors[i][1])
+            img = velu_image(a, b, h)
+            print("  candidate kernel -> image", (hex(img[0]), hex(img[1])))
+            if img[0] == A_E:
+                # image y^2 = x^3 + B_img is isomorphic to E iff
+                # B_img/B_E is a 6th power: (x,y) -> (x/u^2, y/u^3)
+                ratio = img[1] * pow(B_E, P - 2, P) % P
+                u = sixth_root(ratio)
+                if u is not None:
+                    found = (h, u)
+                    break
+        if found:
+            break
+
+    if not found:
+        print("FAILED: no degree-5 kernel maps E' to (a twist-trivial) E")
+        return
+
+    h, u = found
+    print("\nSUCCESS. Kernel polynomial h(x) (monic, low->high coefficients):")
+    print("ISO11_KERNEL = [")
+    for c in h:
+        print(f"    0x{c:096x},")
+    print("]")
+    print(f"ISO11_SCALE_U = 0x{u:x}  # compose Velu with (x,y)->(x/u^2, y/u^3)")
+
+    # self-check: map a few points of E'(Fp) to E via Velu evaluation
+    from_eval_check(a, b, h, u)
+
+
+def sixth_root(v):
+    """A 6th root of v in Fp, or None.
+
+    The expected scaling between the Velu image y^2 = x^3 + B_img and E is a
+    small integer (the isogeny degree's square root pattern — 11 here), so a
+    bounded search suffices for this one-off derivation tool; a generic
+    Tonelli–Shanks is deliberately avoided.
+    """
+    for u in range(2, 1 << 16):
+        if pow(u, 6, P) == v:
+            return u
+    return None
+
+
+def from_eval_check(a, b, h, u=1):
+    d = len(h) - 1
+    hp = pderiv(h)
+    p = power_sums(h, 3)
+    p1, p2 = p[1], p[2]
+
+    def B_of(x):
+        return (x * x % P * x + a * x + b) % P
+
+    def isogeny_eval(x, y):
+        hx = peval(h, x)
+        assert hx != 0, "point in kernel"
+        hpx = peval(hp, x)
+        inv_h = pow(hx, P - 2, P)
+        lam = hpx * inv_h % P  # h'/h at x
+        # T(x) = 6*(x^2 lam - x d - p1) + 2a lam
+        T = (6 * ((x * x % P) * lam - x * d - p1) + 2 * a * lam) % P
+        # U(x) = 4[x^3 lam - x^2 d - x p1 - p2] + 4a[x lam - d] + 4b lam
+        U = (
+            4 * ((x * x % P * x % P) * lam - (x * x % P) * d - x * p1 - p2)
+            + 4 * a * (x * lam - d)
+            + 4 * b * lam
+        ) % P
+        # numerically differentiate U and T is not allowed; use closed forms:
+        # lam' = h''h - h'h' over h^2... easier: full rational forms.
+        # Tn/h and Un/h with Tn, Un polynomials:
+        #   sum 1/(x-xq)   = h'/h
+        #   sum xq/(x-xq)  = (x h' - d h)/h
+        #   sum xq^2/(x-xq)= (x^2 h' - (x d + p1) h)/h
+        #   sum xq^3/(x-xq)= (x^3 h' - (x^2 d + x p1 + p2) h)/h
+        # so Tn = 6(x^2 h' - (xd+p1) h) + 2a h'
+        #    Un = 4(x^3 h' - (x^2 d + x p1 + p2) h) + 4a(x h' - d h) + 4b h'
+        # X = x + Tn/h - d/dx(Un/h) = x + (Tn h - Un' h + Un h')/h^2
+        return None
+
+    # do it with explicit polynomials
+    import numpy as _np  # noqa: F401  (unused; keep host-only)
+
+    x_ = [0, 1]
+    hpoly = list(h)
+    hprime = pderiv(hpoly)
+    Tn = padd(
+        psub(pmul([0, 0, 1], hprime), pmul(padd(pscale(x_, d), [p1]), hpoly)),
+        [],
+    )
+    Tn = pscale(Tn, 6)
+    Tn = padd(Tn, pscale(hprime, 2 * a))
+    Un = pscale(
+        psub(pmul([0, 0, 0, 1], hprime), pmul(padd(padd(pscale([0, 0, 1], d), pscale(x_, p1)), [p2]), hpoly)),
+        4,
+    )
+    Un = padd(Un, pscale(psub(pmul(x_, hprime), pscale(hpoly, d)), 4 * a))
+    Un = padd(Un, pscale(hprime, 4 * b))
+    N2 = padd(psub(pmul(Tn, hpoly), pmul(pderiv(Un), hpoly)), pmul(Un, hprime))
+    N2p = pderiv(N2)
+
+    u2i = pow(u * u % P, P - 2, P)
+    u3i = pow(u * u % P * u % P, P - 2, P)
+
+    def xmap(x):
+        hx = peval(hpoly, x)
+        return (x + peval(N2, x) * pow(hx * hx % P, P - 2, P)) % P * u2i % P
+
+    def ymap(x, y):
+        hx = peval(hpoly, x)
+        hpx = peval(hprime, x)
+        num = (peval(N2p, x) * hx - 2 * peval(N2, x) * hpx) % P
+        return y * (1 + num * pow(hx * hx % P * hx % P, P - 2, P)) % P * u3i % P
+
+    checked = 0
+    xx = 2
+    while checked < 5:
+        rhs = B_of(xx)
+        yy = pow(rhs, (P + 1) // 4, P)
+        if yy * yy % P == rhs:
+            X, Y = xmap(xx), ymap(xx, yy)
+            lhs = Y * Y % P
+            rhs2 = (X * X % P * X + A_E * X + B_E) % P
+            assert lhs == rhs2, f"isogeny image point not on E (x={xx})"
+            checked += 1
+        xx += 1
+    print("self-check: 5 random E' points map onto E  ✓")
+
+
+def peval(a, x):
+    r = 0
+    for c in reversed(a):
+        r = (r * x + c) % P
+    return r
+
+
+if __name__ == "__main__":
+    main()
