@@ -952,6 +952,7 @@ class ConsensusState:
                     val.pub_key.data,
                     vote.sign_bytes(self.state.chain_id),
                     vote.signature,
+                    key_type=getattr(val.pub_key, "type_name", "ed25519"),
                 )
             ]
         )

@@ -50,9 +50,10 @@ def _bucket(n: int, multiple_of: int = 1) -> int:
 
 @dataclass(frozen=True)
 class SigItem:
-    pubkey: bytes  # 32 bytes
+    pubkey: bytes  # 32 bytes (ed25519) or 33 bytes (secp256k1 compressed)
     msg: bytes
     sig: bytes  # 64 bytes
+    key_type: str = "ed25519"
 
 
 class BatchVerifier:
@@ -85,10 +86,29 @@ class BatchVerifier:
             self._nshards = mesh.devices.size
 
     def verify(self, items: list[SigItem]) -> np.ndarray:
-        """Returns a bool accept bitmap aligned with `items`."""
+        """Returns a bool accept bitmap aligned with `items`.
+
+        Mixed-key commits (BASELINE config 4; reference allows ed25519 and
+        secp256k1 validators side by side, crypto/secp256k1/secp256k1.go:192)
+        are partitioned per key type: ed25519 rows ride the device batch,
+        other types verify on host, and the bitmap is re-interleaved.
+        """
         n = len(items)
         if n == 0:
             return np.zeros(0, dtype=bool)
+        other_idx = [
+            i for i, it in enumerate(items) if it.key_type != "ed25519"
+        ]
+        if other_idx:
+            out = np.zeros(n, dtype=bool)
+            ed_idx = [
+                i for i, it in enumerate(items) if it.key_type == "ed25519"
+            ]
+            if ed_idx:
+                out[ed_idx] = self.verify([items[i] for i in ed_idx])
+            for i in other_idx:
+                out[i] = self._verify_host_other(items[i])
+            return out
         if n < self._min_device_batch:
             from . import ed25519 as host
 
@@ -115,6 +135,16 @@ class BatchVerifier:
             s_ok[i] = s_int < L
         out = self._fn(pub, rb, sb, kb, jnp.asarray(s_ok))
         return np.asarray(out)[:n]
+
+    @staticmethod
+    def _verify_host_other(it: SigItem) -> bool:
+        """Host verify for non-ed25519 key types (secp256k1 today; the
+        device kernel partition point for future per-type kernels)."""
+        if it.key_type == "secp256k1":
+            from . import secp256k1
+
+            return secp256k1.PubKey(it.pubkey).verify(it.msg, it.sig)
+        return False
 
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         return bool(self.verify([SigItem(pubkey, msg, sig)])[0])

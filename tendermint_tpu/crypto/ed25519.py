@@ -150,6 +150,8 @@ class PrivKey:
 
     seed: bytes
 
+    type_name = "ed25519"
+
     def __post_init__(self):
         if len(self.seed) != PRIVKEY_SEED_SIZE:
             raise ValueError("ed25519 seed must be 32 bytes")
@@ -184,6 +186,8 @@ class PrivKey:
 @dataclass(frozen=True)
 class PubKey:
     data: bytes
+
+    type_name = "ed25519"
 
     def __post_init__(self):
         if len(self.data) != PUBKEY_SIZE:

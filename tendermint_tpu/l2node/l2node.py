@@ -109,3 +109,22 @@ class L2Node(Protocol):
         """Recompute a batch hash from its header (blocksync replay check,
         reference blocksync/reactor.go:558-600)."""
         ...
+
+    # --- V2 methods for sequencer mode (reference l2node.go:65-84) --------
+
+    def request_block_data_v2(self, parent_hash: bytes):
+        """Assemble the next BlockV2 on top of `parent_hash` via the
+        engine API. Returns (BlockV2, collected_l1_msgs: bool)."""
+        ...
+
+    def apply_block_v2(self, block) -> None:
+        """Apply a BlockV2 to the L2 execution layer (NewL2Block)."""
+        ...
+
+    def get_block_by_number(self, height: int):
+        """BlockV2 by number, or None (eth_getBlockByNumber)."""
+        ...
+
+    def get_latest_block_v2(self):
+        """The latest BlockV2 (eth_blockNumber + eth_getBlockByNumber)."""
+        ...

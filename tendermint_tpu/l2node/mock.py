@@ -126,3 +126,100 @@ class MockL2Node:
 
     def batch_hash(self, batch_header: bytes) -> bytes:
         return hashlib.sha256(batch_header).digest()
+
+    # --- V2 (sequencer mode) ------------------------------------------------
+    # In-memory execution engine for BlockV2 (reference l2node.go:65-84).
+    # Blocks form a hash-linked chain; "execution" is deterministic hashing.
+
+    def _ensure_v2_genesis(self):
+        if not hasattr(self, "v2_chain"):
+            from ..types.block_v2 import BlockV2
+
+            genesis = BlockV2(number=0)
+            genesis.hash = hashlib.sha256(b"mock-l2-genesis").digest()
+            # chain by number; index by hash
+            self.v2_chain: list = [genesis]
+            self.v2_by_hash = {genesis.hash: genesis}
+
+    def seed_v2_height(self, height: int) -> None:
+        """Test helper: advance the mock chain to `height` with unsigned
+        linked blocks (simulates the pre-upgrade L2 state)."""
+        self._ensure_v2_genesis()
+        while self.v2_chain[-1].number < height:
+            parent = self.v2_chain[-1]
+            b, _ = self.request_block_data_v2(parent.hash)
+            self.apply_block_v2(b)
+
+    def request_block_data_v2(self, parent_hash: bytes):
+        self._ensure_v2_genesis()
+        from ..types.block_v2 import BlockV2
+
+        with self._lock:
+            parent = self.v2_by_hash.get(bytes(parent_hash))
+            if parent is None:
+                raise ValueError("unknown parent hash")
+            if self.pending_txs:
+                txs, self.pending_txs = self.pending_txs, []
+            else:
+                txs = [
+                    b"v2tx-%d-%d" % (parent.number + 1, i)
+                    for i in range(self.txs_per_block)
+                ]
+            block = BlockV2(
+                parent_hash=parent.hash,
+                number=parent.number + 1,
+                gas_limit=30_000_000,
+                timestamp=parent.timestamp + 1,
+                transactions=txs,
+                gas_used=21_000 * len(txs),
+            )
+            block.state_root = hashlib.sha256(
+                b"state" + parent.state_root + b"".join(txs)
+            ).digest()
+            block.receipt_root = hashlib.sha256(
+                b"receipts" + block.state_root
+            ).digest()
+            block.hash = hashlib.sha256(
+                block.parent_hash
+                + block.number.to_bytes(8, "big")
+                + block.state_root
+            ).digest()
+            return block, False
+
+    def apply_block_v2(self, block) -> None:
+        self._ensure_v2_genesis()
+        with self._lock:
+            head = self.v2_chain[-1]
+            if block.parent_hash != head.hash:
+                raise ValueError("apply_block_v2: parent mismatch")
+            if block.number != head.number + 1:
+                raise ValueError("apply_block_v2: height mismatch")
+            # Content integrity: the sequencer signature covers only the
+            # 32-byte hash, so the execution layer must recompute the hash
+            # from the block contents and reject tampering (the real geth
+            # re-executes; reference l2node.go:72-76 ApplyBlockV2 via
+            # Engine API NewL2Block).
+            expect_state = hashlib.sha256(
+                b"state" + head.state_root + b"".join(block.transactions)
+            ).digest()
+            expect_hash = hashlib.sha256(
+                block.parent_hash
+                + block.number.to_bytes(8, "big")
+                + expect_state
+            ).digest()
+            if block.state_root != expect_state or block.hash != expect_hash:
+                raise ValueError("apply_block_v2: content/hash mismatch")
+            self.v2_chain.append(block)
+            self.v2_by_hash[block.hash] = block
+
+    def get_block_by_number(self, height: int):
+        self._ensure_v2_genesis()
+        with self._lock:
+            if 0 <= height < len(self.v2_chain):
+                return self.v2_chain[height]
+            return None
+
+    def get_latest_block_v2(self):
+        self._ensure_v2_genesis()
+        with self._lock:
+            return self.v2_chain[-1]

@@ -1,0 +1,384 @@
+"""BlockBroadcastReactor — sequencer-mode block gossip + sync catchup.
+
+Reference: sequencer/broadcast_reactor.go. Two channels:
+- 0x50 broadcast (signature-verified BlockV2 gossip, :24-25),
+- 0x51 sync (BlockRequest / BlockResponseV2 / NoBlockResponse, no
+  signature verification — blocks fetched by request are trusted via the
+  hash-linked chain, :26).
+
+Shape: the sequencer node drains StateV2's broadcast queue and gossips;
+follower nodes run an apply/sync routine that periodically drains the
+pending cache and requests missing heights when the gap to the best peer
+exceeds `SMALL_GAP_THRESHOLD` (:321-383).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Optional
+
+from ..libs import protoio as pio
+from ..libs.log import Logger
+from ..p2p.mconn import ChannelDescriptor
+from ..p2p.switch import Reactor
+from ..p2p.transport import Peer
+from ..types.block_v2 import BlockV2
+from .caches import (
+    MAX_PENDING_HEIGHT_BEHIND,
+    BlockRingBuffer,
+    HashSet,
+    PeerHashSet,
+    PendingBlockCache,
+)
+from .signer import ErrInvalidSignature, SequencerVerifier
+from .state_v2 import StateV2
+
+BLOCK_BROADCAST_CHANNEL = 0x50
+SEQUENCER_SYNC_CHANNEL = 0x51
+
+SMALL_GAP_THRESHOLD = 20
+RECENT_BLOCKS_CAPACITY = 1000
+SEEN_BLOCKS_CAPACITY = 2000
+PEER_SENT_CAPACITY = 500
+APPLY_INTERVAL = 10.0
+SYNC_INTERVAL = 10.0
+
+# message kinds (field 1)
+_BLOCK_RESPONSE_V2 = 1
+_BLOCK_REQUEST = 2
+_NO_BLOCK_RESPONSE = 3
+_STATUS = 4  # height advertisement (the reference reuses blocksync's pool)
+
+
+def _enc(kind: int, height: int = 0, block: Optional[BlockV2] = None) -> bytes:
+    out = pio.field_varint(1, kind)
+    if height:
+        out += pio.field_varint(2, height)
+    if block is not None:
+        out += pio.field_bytes(3, block.encode())
+    return out
+
+
+def _dec(data: bytes) -> tuple[int, int, Optional[BlockV2]]:
+    kind = height = 0
+    block = None
+    for num, _wt, val in pio.iter_fields(data):
+        if num == 1:
+            kind = val
+        elif num == 2:
+            height = val
+        elif num == 3:
+            block = BlockV2.decode(val)
+    return kind, height, block
+
+
+class BlockBroadcastReactor(Reactor):
+    def __init__(
+        self,
+        state_v2: StateV2,
+        verifier: Optional[SequencerVerifier] = None,
+        wait_sync: bool = False,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("BlockBroadcast")
+        self.state_v2 = state_v2
+        self.verifier = verifier if verifier is not None else state_v2.verifier
+        self.wait_sync = wait_sync
+        self.recent_blocks = BlockRingBuffer(RECENT_BLOCKS_CAPACITY)
+        self.pending_cache = PendingBlockCache()
+        self.seen_blocks = HashSet(SEEN_BLOCKS_CAPACITY)
+        self.peer_sent = PeerHashSet(PEER_SENT_CAPACITY)
+        self.peer_heights: dict[str, int] = {}
+        # heights we asked for on the sync channel; unsolicited sync
+        # responses are dropped (the unauthenticated channel must not let
+        # an arbitrary peer extend our chain unprompted)
+        self.requested_heights: set[int] = set()
+        self._apply_lock = asyncio.Lock()
+        self.sequencer_started = False
+        self._tasks: list[asyncio.Task] = []
+        self.logger = (logger or state_v2.logger).with_fields(
+            module="broadcastReactor"
+        )
+        # test hooks
+        self.apply_interval = APPLY_INTERVAL
+        self.sync_interval = SYNC_INTERVAL
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                id=BLOCK_BROADCAST_CHANNEL, priority=6, send_queue_capacity=1000
+            ),
+            ChannelDescriptor(
+                id=SEQUENCER_SYNC_CHANNEL, priority=5, send_queue_capacity=1000
+            ),
+        ]
+
+    # --- lifecycle (broadcast_reactor.go:96-129) ----------------------------
+
+    async def on_start(self) -> None:
+        if not self.wait_sync:
+            await self.start_sequencer_routines()
+
+    async def start_sequencer_routines(self) -> None:
+        """Start production/apply routines; called at upgrade or after
+        blocksync catches up past the upgrade height (:104-125)."""
+        if self.sequencer_started:
+            self.logger.error("sequencer routines already started")
+            return
+        self.wait_sync = False
+        if not self.state_v2.is_running:
+            await self.state_v2.start()
+        if self.state_v2.is_sequencer_mode():
+            self._tasks.append(
+                asyncio.create_task(self._broadcast_routine())
+            )
+        else:
+            self._tasks.append(asyncio.create_task(self._apply_routine()))
+        self.sequencer_started = True
+
+    async def on_stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        if self.state_v2.is_running:
+            await self.state_v2.stop()
+
+    async def add_peer(self, peer: Peer) -> None:
+        self.peer_sent.add_peer(peer.id)
+        # advertise our height so peers can catch up from us
+        peer.try_send(
+            SEQUENCER_SYNC_CHANNEL,
+            _enc(_STATUS, height=self.state_v2.latest_height()),
+        )
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        self.peer_sent.remove_peer(peer.id)
+        self.peer_heights.pop(peer.id, None)
+
+    # --- receive (broadcast_reactor.go:146-205) ------------------------------
+
+    async def receive(self, channel_id: int, peer: Peer, msg: bytes) -> None:
+        try:
+            kind, height, block = _dec(msg)
+        except Exception as e:
+            self.logger.error("bad sequencer msg", err=str(e))
+            await self.switch.stop_peer_for_error(peer, "bad sequencer msg")
+            return
+        if channel_id == BLOCK_BROADCAST_CHANNEL:
+            if kind == _BLOCK_RESPONSE_V2 and block is not None:
+                await self._on_block_v2(block, peer, verify_sig=True)
+        elif channel_id == SEQUENCER_SYNC_CHANNEL:
+            if kind == _BLOCK_REQUEST:
+                await self._on_block_request(height, peer)
+            elif kind == _BLOCK_RESPONSE_V2 and block is not None:
+                # only heights we actually requested skip signature
+                # verification; anything unsolicited goes through the
+                # signed path so a rogue peer can't push unsigned blocks
+                if block.number in self.requested_heights:
+                    self.requested_heights.discard(block.number)
+                    await self._on_block_v2(block, peer, verify_sig=False)
+                else:
+                    await self._on_block_v2(block, peer, verify_sig=True)
+            elif kind == _STATUS:
+                self.peer_heights[peer.id] = height
+            # _NO_BLOCK_RESPONSE: nothing to do (logged by reference too)
+
+    # --- routines -----------------------------------------------------------
+
+    async def _broadcast_routine(self) -> None:
+        """Sequencer side: drain StateV2's queue, gossip (:215-227)."""
+        while True:
+            block = await self.state_v2.broadcast_queue.get()
+            self.recent_blocks.add(block)
+            self._advertise_height(block.number)
+            self._gossip_block(block, from_peer="")
+
+    async def _apply_routine(self) -> None:
+        """Follower side: periodic pending-cache drain + gap check
+        (:229-249)."""
+        apply_t = sync_t = 0.0
+        tick = min(self.apply_interval, self.sync_interval, 0.5)
+        while True:
+            await asyncio.sleep(tick)
+            apply_t += tick
+            sync_t += tick
+            try:
+                if apply_t >= self.apply_interval:
+                    apply_t = 0.0
+                    await self.try_apply_from_cache()
+                if sync_t >= self.sync_interval:
+                    sync_t = 0.0
+                    await self.check_sync_gap()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # the apply/sync loop must survive transient peer errors
+                self.logger.error("apply routine error", err=str(e))
+
+    # --- core logic (broadcast_reactor.go:251-316) ---------------------------
+
+    async def _on_block_v2(
+        self, block: BlockV2, src: Peer, verify_sig: bool
+    ) -> None:
+        if self.seen_blocks.add(block.hash) and verify_sig:
+            return  # broadcast dedup; sync responses bypass dedup
+        self.peer_sent.add(src.id, block.hash)
+        self.peer_heights[src.id] = max(
+            self.peer_heights.get(src.id, 0), block.number
+        )
+        local_height = self.state_v2.latest_height()
+        if self._is_next_block(block):
+            try:
+                await self.apply_block(block, verify_sig)
+            except ErrInvalidSignature:
+                # un-poison dedup: a forged copy arriving first must not
+                # make us drop the genuine broadcast of this hash later
+                self.seen_blocks.discard(block.hash)
+                self.logger.error(
+                    "invalid block signature", number=block.number
+                )
+                return
+            except Exception as e:
+                self.logger.error(
+                    "apply failed", number=block.number, err=str(e)
+                )
+                if verify_sig:
+                    self.pending_cache.add(block, local_height)
+                return
+            if verify_sig:
+                self._gossip_block(block, from_peer=src.id)
+            # applying may unlock pending children immediately
+            await self.try_apply_from_cache()
+        elif verify_sig:
+            self.pending_cache.add(block, local_height)
+
+    async def try_apply_from_cache(self) -> None:
+        """Apply the longest pending chain on top of the head (:318-349)."""
+        current = self.state_v2.latest_block
+        if current is None:
+            return
+        chain = self.pending_cache.get_longest_chain(current.hash)
+        for block in chain:
+            if not self._is_next_block(block):
+                break
+            try:
+                await self.apply_block(block, verify_sig=True)
+            except Exception as e:
+                self.logger.error(
+                    "apply from cache failed", number=block.number, err=str(e)
+                )
+                break
+        local_height = self.state_v2.latest_height()
+        if local_height > MAX_PENDING_HEIGHT_BEHIND:
+            self.pending_cache.prune_below(
+                local_height - MAX_PENDING_HEIGHT_BEHIND
+            )
+
+    async def check_sync_gap(self) -> None:
+        """Request missing blocks when we're far behind (:351-383)."""
+        local_height = self.state_v2.latest_height()
+        self.requested_heights = {
+            h for h in self.requested_heights if h > local_height
+        }
+        max_peer_height = max(self.peer_heights.values(), default=0)
+        if max_peer_height - local_height <= SMALL_GAP_THRESHOLD:
+            return
+        await self._request_missing_blocks(local_height + 1, max_peer_height)
+
+    async def _request_missing_blocks(self, start: int, end: int) -> None:
+        peers = list(self.switch.peers.values()) if self.switch else []
+        if not peers:
+            return
+        # bound per cycle like the reference (smallGapThreshold per cycle)
+        for height in range(start, min(end, start + SMALL_GAP_THRESHOLD) + 1):
+            peer = self._find_peer_with_height(peers, height)
+            if peer is None:
+                continue
+            self.requested_heights.add(height)
+            peer.try_send(
+                SEQUENCER_SYNC_CHANNEL, _enc(_BLOCK_REQUEST, height=height)
+            )
+
+    def _find_peer_with_height(self, peers, height: int):
+        n = len(peers)
+        start = random.randrange(n)
+        for i in range(n):
+            peer = peers[(start + i) % n]
+            if self.peer_heights.get(peer.id, 0) >= height:
+                return peer
+        return None
+
+    def _is_next_block(self, block: BlockV2) -> bool:
+        current = self.state_v2.latest_block
+        if current is None:
+            return block.number == self.state_v2.latest_height() + 1
+        return (
+            block.number == current.number + 1
+            and block.parent_hash == current.hash
+        )
+
+    async def apply_block(self, block: BlockV2, verify_sig: bool) -> None:
+        """Verify + apply atomically (:389-420)."""
+        async with self._apply_lock:
+            if verify_sig and not self._verify_signature(block):
+                raise ErrInvalidSignature(str(block.number))
+            current = self.state_v2.latest_block
+            if current is not None and block.parent_hash != current.hash:
+                raise ValueError("parent mismatch")
+            await self.state_v2.apply_block(block)
+            self.recent_blocks.add(block)
+            self._advertise_height(block.number)
+            self.logger.info(
+                "applied block", number=block.number, verify_sig=verify_sig
+            )
+
+    def _verify_signature(self, block: BlockV2) -> bool:
+        """Recover signer address, check against the sequencer set
+        (:422-455)."""
+        if not block.signature:
+            return False
+        addr = block.recover_signer()
+        if addr is None:
+            return False
+        if self.verifier is None:
+            return False
+        return self.verifier.is_sequencer(addr)
+
+    # --- gossip (broadcast_reactor.go:457-511) -------------------------------
+
+    def _gossip_block(self, block: BlockV2, from_peer: str) -> None:
+        if self.switch is None:
+            return
+        msg = _enc(_BLOCK_RESPONSE_V2, block=block)
+        for peer in list(self.switch.peers.values()):
+            if peer.id == from_peer:
+                continue
+            if self.peer_sent.contains(peer.id, block.hash):
+                continue
+            if peer.try_send(BLOCK_BROADCAST_CHANNEL, msg):
+                self.peer_sent.add(peer.id, block.hash)
+
+    def _advertise_height(self, height: int) -> None:
+        if self.switch is None:
+            return
+        msg = _enc(_STATUS, height=height)
+        for peer in list(self.switch.peers.values()):
+            peer.try_send(SEQUENCER_SYNC_CHANNEL, msg)
+
+    async def _on_block_request(self, height: int, src: Peer) -> None:
+        """Serve a block from the recent cache or the L2 node (:513-540)."""
+        block = self.recent_blocks.get_by_height(height)
+        if block is None:
+            block = self.state_v2.get_block_by_number(height)
+        if block is None:
+            src.try_send(
+                SEQUENCER_SYNC_CHANNEL, _enc(_NO_BLOCK_RESPONSE, height=height)
+            )
+            return
+        src.try_send(SEQUENCER_SYNC_CHANNEL, _enc(_BLOCK_RESPONSE_V2, block=block))

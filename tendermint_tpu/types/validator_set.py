@@ -244,6 +244,7 @@ class ValidatorSet:
                     val.pub_key.data,
                     commit.vote_sign_bytes(chain_id, i),
                     cs.signature,
+                    key_type=getattr(val.pub_key, "type_name", "ed25519"),
                 )
             )
             idxs.append(i)
@@ -323,6 +324,7 @@ class ValidatorSet:
                     val.pub_key.data,
                     commit.vote_sign_bytes(chain_id, i),
                     cs.signature,
+                    key_type=getattr(val.pub_key, "type_name", "ed25519"),
                 )
             )
             powers.append(val.voting_power)

@@ -1,0 +1,332 @@
+"""Sequencer mode: BlockV2 production, signed gossip, sync catchup.
+
+Mirrors the reference's sequencer suite (sequencer/state_v2_test.go,
+block_cache_test.go — 27 tests) plus an end-to-end net over real p2p.
+"""
+
+import asyncio
+
+from tendermint_tpu.crypto import secp256k1
+from tendermint_tpu.l2node.mock import MockL2Node
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.transport import MultiplexTransport, NetAddress
+from tendermint_tpu.sequencer import (
+    BlockBroadcastReactor,
+    BlockRingBuffer,
+    HashSet,
+    LocalSigner,
+    PendingBlockCache,
+    StateV2,
+    StaticSequencerVerifier,
+)
+from tendermint_tpu.types.block_v2 import BlockV2
+
+NETWORK = "seq-chain"
+
+
+# --- caches ----------------------------------------------------------------
+
+
+def test_ring_buffer_eviction():
+    rb = BlockRingBuffer(capacity=3)
+    for n in range(5):
+        rb.add(BlockV2(number=n, hash=bytes([n]) * 32))
+    assert rb.get_by_height(1) is None
+    assert rb.get_by_height(4).number == 4
+    assert len(rb) == 3
+
+
+def test_hash_set_dedup_and_eviction():
+    s = HashSet(capacity=2)
+    assert not s.add(b"a")
+    assert s.add(b"a")  # duplicate
+    s.add(b"b")
+    s.add(b"c")  # evicts "a"
+    assert b"a" not in s
+    assert b"c" in s
+
+
+def test_pending_cache_longest_chain():
+    c = PendingBlockCache()
+    root = b"\x00" * 32
+
+    def blk(n, h, parent):
+        return BlockV2(number=n, hash=h * 32, parent_hash=parent)
+
+    # two forks off root: [a1] and [b1 <- b2]
+    a1 = blk(1, b"\x0a", root)
+    b1 = blk(1, b"\x0b", root)
+    b2 = blk(2, b"\x0c", b1.hash)
+    for b in (a1, b1, b2):
+        assert c.add(b, local_height=0)
+    chain = c.get_longest_chain(root)
+    assert [b.number for b in chain] == [1, 2]
+    assert chain[0].hash == b1.hash
+    c.prune_below(1)
+    assert c.get(a1.hash) is None and c.get(b1.hash) is None
+    assert c.get(b2.hash) is not None
+
+
+def test_pending_cache_height_window():
+    c = PendingBlockCache()
+    far = BlockV2(number=500, hash=b"\x01" * 32, parent_hash=b"\x02" * 32)
+    assert not c.add(far, local_height=10)  # too far ahead
+    assert c.add(far, local_height=450)
+
+
+# --- BlockV2 signature semantics -------------------------------------------
+
+
+def test_block_v2_sign_recover_roundtrip():
+    key = secp256k1.PrivKey.from_secret(b"seq-key")
+    signer = LocalSigner(key)
+    l2 = MockL2Node()
+    block, _ = l2.request_block_data_v2(l2.get_latest_block_v2().hash)
+    block.signature = signer.sign(block.hash)
+    assert block.recover_signer() == signer.address()
+    # wire roundtrip preserves recoverability
+    rt = BlockV2.decode(block.encode())
+    assert rt.recover_signer() == signer.address()
+    assert rt.transactions == block.transactions
+    # a flipped signature byte recovers a different (or no) signer
+    bad = BlockV2.decode(block.encode())
+    bad.signature = bytes([block.signature[0] ^ 1]) + block.signature[1:]
+    assert bad.recover_signer() != signer.address()
+
+
+# --- StateV2 production -----------------------------------------------------
+
+
+def test_state_v2_produces_signed_blocks():
+    key = secp256k1.PrivKey.from_secret(b"producer")
+    signer = LocalSigner(key)
+    l2 = MockL2Node()
+    verifier = StaticSequencerVerifier([signer.address()])
+
+    async def run():
+        sv = StateV2(l2, block_interval=0.01, signer=signer, verifier=verifier)
+        await sv.start()
+        b1 = await sv.produce_block()
+        b2 = await sv.produce_block()
+        await sv.stop()
+        return b1, b2
+
+    b1, b2 = asyncio.run(run())
+    assert b2.parent_hash == b1.hash
+    assert b1.recover_signer() == signer.address()
+    assert l2.get_latest_block_v2().hash == b2.hash
+
+
+# --- end-to-end over p2p ----------------------------------------------------
+
+
+def _build_seq_node(signer, verifier, *, wait_sync=False, l2=None):
+    l2 = l2 or MockL2Node()
+    sv = StateV2(l2, block_interval=0.05, signer=signer, verifier=verifier)
+    nk = NodeKey.generate()
+    transport = None
+    sw = None
+
+    def node_info():
+        return NodeInfo(
+            node_id=nk.id,
+            listen_addr=f"127.0.0.1:{transport.listen_port}",
+            network=NETWORK,
+            channels=sw.channels() if sw else b"",
+        )
+
+    transport = MultiplexTransport(nk, node_info)
+    sw = Switch(transport)
+    reactor = BlockBroadcastReactor(sv, verifier, wait_sync=wait_sync)
+    reactor.apply_interval = 0.1
+    reactor.sync_interval = 0.1
+    sw.add_reactor("sequencer", reactor)
+    return sv, reactor, nk, transport, sw
+
+
+async def _start_and_connect(nodes):
+    for _, _, _, t, sw in nodes:
+        await t.listen()
+        await sw.start()
+    for i, (_, _, nk_i, t_i, sw_i) in enumerate(nodes):
+        for j, (_, _, nk_j, t_j, _) in enumerate(nodes):
+            if j <= i:
+                continue
+            await sw_i.dial_peer(NetAddress(nk_j.id, "127.0.0.1", t_j.listen_port))
+
+
+def test_sequencer_gossip_and_follower_apply():
+    key = secp256k1.PrivKey.from_secret(b"seq-e2e")
+    signer = LocalSigner(key)
+    verifier = StaticSequencerVerifier([signer.address()])
+
+    async def run():
+        seq = _build_seq_node(signer, verifier)
+        fol = _build_seq_node(None, verifier)
+        nodes = [seq, fol]
+        await _start_and_connect(nodes)
+        for _, r, *_ in nodes:
+            await r.on_start()
+        # wait until the follower applied a few gossiped blocks
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if fol[0].latest_height() >= 3:
+                break
+        seq_h = seq[0].latest_height()
+        fol_h = fol[0].latest_height()
+        assert fol_h >= 3, f"follower stuck at {fol_h} (seq at {seq_h})"
+        assert (
+            fol[0].latest_block.recover_signer() == signer.address()
+        )
+        for _, r, _, _, sw in nodes:
+            await r.on_stop()
+            await sw.stop()
+
+    asyncio.run(run())
+
+
+def test_follower_rejects_wrong_signer():
+    seq_key = secp256k1.PrivKey.from_secret(b"real-seq")
+    rogue_key = secp256k1.PrivKey.from_secret(b"rogue")
+    signer = LocalSigner(rogue_key)  # rogue signs blocks
+    verifier = StaticSequencerVerifier(
+        [LocalSigner(seq_key).address()]
+    )  # ...but only real-seq is allowed
+
+    async def run():
+        seq = _build_seq_node(signer, verifier)
+        fol = _build_seq_node(None, verifier)
+        nodes = [seq, fol]
+        await _start_and_connect(nodes)
+        for _, r, *_ in nodes:
+            await r.on_start()
+        await asyncio.sleep(0.5)
+        h = fol[0].latest_height()
+        for _, r, _, _, sw in nodes:
+            await r.on_stop()
+            await sw.stop()
+        return h
+
+    assert asyncio.run(run()) == 0, "follower applied a rogue-signed block"
+
+
+def test_sync_gap_catchup():
+    """A follower joining far behind fetches blocks over the sync channel
+    (reference checkSyncGap + requestMissingBlocks :351-383)."""
+    key = secp256k1.PrivKey.from_secret(b"seq-gap")
+    signer = LocalSigner(key)
+    verifier = StaticSequencerVerifier([signer.address()])
+
+    async def run():
+        seq = _build_seq_node(signer, verifier)
+        # pre-produce 30 blocks (> SMALL_GAP_THRESHOLD) before follower joins
+        await seq[0].start()
+        for _ in range(30):
+            await seq[0].produce_block()
+        fol = _build_seq_node(None, verifier)
+        nodes = [seq, fol]
+        await _start_and_connect(nodes)
+        seq[1].sequencer_started = True  # StateV2 already started above
+        seq[1]._tasks.append(
+            asyncio.create_task(seq[1]._broadcast_routine())
+        )
+        await fol[1].on_start()
+        for _ in range(200):
+            await asyncio.sleep(0.05)
+            if fol[0].latest_height() >= 30:
+                break
+        h = fol[0].latest_height()
+        for _, r, _, _, sw in nodes:
+            await r.on_stop()
+            await sw.stop()
+        return h
+
+    assert asyncio.run(run()) >= 30
+
+
+def test_bft_upgrade_hands_off_to_sequencer():
+    """A BFT chain crossing upgrade_height switches to sequencer mode and
+    keeps producing BlockV2s (reference node.go:1612-1632
+    switchToSequencerMode wired from consensus/state.go:1921-1938)."""
+    from .helpers import make_genesis, make_validators
+    from .test_consensus import make_node
+
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    key = secp256k1.PrivKey.from_secret(b"upgrade-seq")
+    signer = LocalSigner(key)
+    verifier = StaticSequencerVerifier([signer.address()])
+    l2 = MockL2Node()
+
+    async def run():
+        sv = StateV2(l2, block_interval=999, signer=signer, verifier=verifier)
+        produced = []
+
+        async def on_upgrade(state):
+            # mirror switchToSequencerMode: seed L2 to the BFT height and
+            # start StateV2 production
+            l2.seed_v2_height(state.last_block_height)
+            await sv.start()
+            produced.append(await sv.produce_block())
+            produced.append(await sv.produce_block())
+
+        cs, app, _, bs, ss = make_node(
+            vs, pvs[0], genesis, l2=l2, upgrade_height=2, on_upgrade=on_upgrade
+        )
+        await cs.start()
+        await cs.wait_for_height(2, timeout=30)
+        await asyncio.sleep(0.2)
+        await cs.stop()
+        await sv.stop()
+        return produced
+
+    produced = asyncio.run(run())
+    assert len(produced) == 2
+    assert produced[0].number == 3  # continues above the BFT chain
+    assert produced[1].number == 4
+    assert produced[0].recover_signer() == signer.address()
+
+
+def test_out_of_order_blocks_buffered_in_pending_cache():
+    """Future blocks land in the pending cache and apply once the gap
+    closes (reference onBlockV2 future-block caching + tryApplyFromCache)."""
+    key = secp256k1.PrivKey.from_secret(b"seq-ooo")
+    signer = LocalSigner(key)
+    verifier = StaticSequencerVerifier([signer.address()])
+
+    async def run():
+        l2 = MockL2Node()
+        sv = StateV2(l2, block_interval=999, signer=None, verifier=verifier)
+        await sv.start()
+        reactor = BlockBroadcastReactor(sv, verifier)
+
+        # build a 3-block signed chain out-of-band
+        src_l2 = MockL2Node()
+        chain = []
+        parent = src_l2.get_latest_block_v2().hash
+        for _ in range(3):
+            b, _ = src_l2.request_block_data_v2(parent)
+            b.signature = signer.sign(b.hash)
+            src_l2.apply_block_v2(b)
+            chain.append(b)
+            parent = b.hash
+
+        class FakePeer:
+            id = "fake-peer"
+
+            def try_send(self, ch, msg):
+                return True
+
+        peer = FakePeer()
+        # deliver 3, 2 (buffered), then 1 (applies; cache drains the rest)
+        await reactor._on_block_v2(chain[2], peer, verify_sig=True)
+        await reactor._on_block_v2(chain[1], peer, verify_sig=True)
+        assert sv.latest_height() == 0
+        assert reactor.pending_cache.size() == 2
+        await reactor._on_block_v2(chain[0], peer, verify_sig=True)
+        assert sv.latest_height() == 3
+        await sv.stop()
+
+    asyncio.run(run())
