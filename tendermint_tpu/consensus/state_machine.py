@@ -39,7 +39,6 @@ from ..state.state import State
 from ..store.block_store import BlockStore
 from ..types.block import Block, Commit
 from ..types.block_id import BlockID
-from ..types.evidence import DuplicateVoteEvidence
 from ..types.part_set import Part, PartSet
 from ..types.proposal import Proposal
 from ..types.vote import Vote, VoteType
@@ -851,19 +850,15 @@ class ConsensusState:
         try:
             return await self._add_vote(vote, peer_id)
         except ConflictingVoteError as e:
-            # equivocation: turn it into evidence (reference :2274-2330)
-            if self.evpool is not None and self._vote_in_valset(vote):
-                _, val = self.state.validators.get_by_address(
-                    vote.validator_address
-                )
-                ev = DuplicateVoteEvidence.from_votes(
-                    e.existing,
-                    e.new,
-                    self.state.validators.total_voting_power(),
-                    val.voting_power if val else 0,
-                    self.now_ns(),
-                )
-                self.evpool.add_evidence(ev, self.state)
+            # equivocation: report to the pool, which resolves the
+            # validator against the HISTORICAL set at the vote's height and
+            # stamps the committed block's time on the next Update
+            # (reference ReportConflictingVotes, evidence/pool.go:179 +
+            # processConsensusBuffer :459). No current-set gate here: an
+            # H-1 straggler equivocation from a just-removed validator is
+            # still valid evidence.
+            if self.evpool is not None:
+                self.evpool.report_conflicting_votes(e.existing, e.new)
             self.logger.info(
                 "conflicting vote captured",
                 validator=vote.validator_address.hex()[:12],

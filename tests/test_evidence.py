@@ -1,0 +1,272 @@
+"""Evidence pool + verification + gossip + consensus integration.
+
+Mirrors the reference suite shape (evidence/pool_test.go, verify_test.go,
+reactor_test.go) in compressed form.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.evidence import EvidencePool, EvidenceReactor
+from tendermint_tpu.evidence.verify import verify_duplicate_vote
+from tendermint_tpu.store.kv import MemKV
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.types.vote import Vote, VoteType
+
+from .helpers import CHAIN_ID, T0, make_genesis, make_validators
+
+
+def _conflicting_votes(pv, index, height, round_=0, ts=T0):
+    """Two precommits from one validator for different blocks."""
+    def mk(h):
+        v = Vote(
+            type=VoteType.PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=BlockID(
+                hash=h, part_set_header=PartSetHeader(1, h)
+            ),
+            timestamp_ns=ts,
+            validator_address=pv.get_pub_key().address(),
+            validator_index=index,
+        )
+        pv.sign_vote(CHAIN_ID, v)
+        return v
+
+    return mk(b"\x01" * 32), mk(b"\x02" * 32)
+
+
+def test_verify_duplicate_vote_rules():
+    vs, pvs = make_validators(4)
+    va, vb = _conflicting_votes(pvs[0], 0, height=3)
+    ev = DuplicateVoteEvidence.from_votes(
+        va, vb, vs.total_voting_power(), 10, T0
+    )
+    ev.validate_basic()
+    verify_duplicate_vote(ev, CHAIN_ID, vs)
+
+    # wrong total power
+    bad = DuplicateVoteEvidence.from_votes(va, vb, 999, 10, T0)
+    with pytest.raises(ValueError, match="total voting power"):
+        verify_duplicate_vote(bad, CHAIN_ID, vs)
+
+    # tampered signature
+    va2, vb2 = _conflicting_votes(pvs[0], 0, height=3)
+    vb2.signature = bytes([vb2.signature[0] ^ 1]) + vb2.signature[1:]
+    bad2 = DuplicateVoteEvidence.from_votes(
+        va2, vb2, vs.total_voting_power(), 10, T0
+    )
+    with pytest.raises(ValueError, match="invalid signature"):
+        verify_duplicate_vote(bad2, CHAIN_ID, vs)
+
+    # same block id -> not conflicting
+    with pytest.raises(ValueError):
+        ev_same = DuplicateVoteEvidence.from_votes(
+            va, va, vs.total_voting_power(), 10, T0
+        )
+        ev_same.validate_basic()
+
+
+def _run_chain_to(cs, h, timeout=60):
+    return cs.wait_for_height(h, timeout=timeout)
+
+
+def test_equivocation_lands_in_committed_block():
+    """The full loop (reference pool_test + e2e evidence test): consensus
+    captures conflicting votes -> pool constructs evidence on Update ->
+    proposer includes it -> it commits -> pool marks it committed."""
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import State
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.consensus.state_machine import (
+        ConsensusConfig,
+        ConsensusState,
+    )
+    from tendermint_tpu.l2node.mock import MockL2Node
+
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+
+    l2 = MockL2Node()
+    app = KVStoreApplication()
+    state = State.from_genesis(genesis)
+    state_store = StateStore(MemKV())
+    state_store.bootstrap(state)
+    block_store = BlockStore(MemKV())
+    pool = EvidencePool(MemKV(), state_store, block_store)
+    executor = BlockExecutor(
+        state_store, block_store, LocalClient(app), l2, evidence_pool=pool
+    )
+    cs = ConsensusState(
+        ConsensusConfig.test_config(),
+        state,
+        executor,
+        block_store,
+        l2,
+        priv_validator=pvs[0],
+        evidence_pool=pool,
+    )
+
+    # a second signer for the same validator key to craft the equivocation
+    rogue_a, rogue_b = _conflicting_votes(pvs[0], 0, height=1)
+
+    async def run():
+        await cs.start()
+        await cs.wait_for_height(1, timeout=30)
+        # feed the conflicting precommits for an already-decided height
+        # through the vote path (as if gossiped by a peer)
+
+        # pool must know about them via the consensus conflict capture:
+        # report directly (the net path is exercised in the reactor test)
+        pool.report_conflicting_votes(rogue_a, rogue_b)
+        # next committed height triggers pool.update -> evidence built
+        await cs.wait_for_height(3, timeout=30)
+        for h in range(2, 4):
+            blk = block_store.load_block(h)
+            if blk and blk.evidence:
+                return blk
+        # one more height in case inclusion lagged
+        await cs.wait_for_height(4, timeout=30)
+        blk = block_store.load_block(4)
+        await cs.stop()
+        return blk
+
+    blk = asyncio.run(run())
+    assert blk is not None and blk.evidence, "evidence never committed"
+    ev = blk.evidence[0]
+    assert isinstance(ev, DuplicateVoteEvidence)
+    assert ev.vote_a.validator_address == pvs[0].get_pub_key().address()
+    assert pool.size() == 0, "evidence still pending after commit"
+
+
+def test_pool_rejects_old_and_unknown_evidence():
+    from tendermint_tpu.state.state import State
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+
+    vs, pvs = make_validators(2)
+    genesis = make_genesis(vs)
+    state = State.from_genesis(genesis)
+    state_store = StateStore(MemKV())
+    state_store.bootstrap(state)
+    block_store = BlockStore(MemKV())
+    pool = EvidencePool(MemKV(), state_store, block_store)
+
+    va, vb = _conflicting_votes(pvs[0], 0, height=99)
+    ev = DuplicateVoteEvidence.from_votes(
+        va, vb, vs.total_voting_power(), 10, T0
+    )
+    with pytest.raises(ValueError, match="don't have header"):
+        pool.add_evidence(ev)
+
+
+def test_reactor_gossips_evidence_between_peers():
+    """Evidence added on node A reaches node B's pool over p2p channel
+    0x38 (reference reactor_test.go TestReactorBroadcastEvidence)."""
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.p2p.node_info import NodeInfo
+    from tendermint_tpu.p2p.switch import Switch
+    from tendermint_tpu.p2p.transport import MultiplexTransport, NetAddress
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import State
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.consensus.state_machine import (
+        ConsensusConfig,
+        ConsensusState,
+    )
+    from tendermint_tpu.l2node.mock import MockL2Node
+
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+
+    def build():
+        l2 = MockL2Node()
+        app = KVStoreApplication()
+        state = State.from_genesis(genesis)
+        ss = StateStore(MemKV())
+        ss.bootstrap(state)
+        bs = BlockStore(MemKV())
+        pool = EvidencePool(MemKV(), ss, bs)
+        executor = BlockExecutor(
+            ss, bs, LocalClient(app), l2, evidence_pool=pool
+        )
+        cs = ConsensusState(
+            ConsensusConfig.test_config(),
+            state,
+            executor,
+            bs,
+            l2,
+            priv_validator=pvs[0] if not built else None,
+            evidence_pool=pool,
+        )
+        nk = NodeKey.generate()
+        transport = None
+        sw = None
+
+        def node_info():
+            return NodeInfo(
+                node_id=nk.id,
+                listen_addr=f"127.0.0.1:{transport.listen_port}",
+                network="ev-chain",
+                channels=sw.channels() if sw else b"",
+            )
+
+        transport = MultiplexTransport(nk, node_info)
+        sw = Switch(transport)
+        sw.add_reactor("evidence", EvidenceReactor(pool))
+        built.append(1)
+        return cs, pool, bs, ss, nk, transport, sw
+
+    built = []
+
+    async def run():
+        a = build()
+        b = build()
+        for n in (a, b):
+            await n[5].listen()
+            await n[6].start()
+        await a[6].dial_peer(
+            NetAddress(b[4].id, "127.0.0.1", b[5].listen_port)
+        )
+        # node A runs the chain so both stores have height-1 metadata;
+        # replicate A's blocks into B's stores so verification passes
+        cs_a = a[0]
+        await cs_a.start()
+        await cs_a.wait_for_height(1, timeout=30)
+        # stop A's chain BEFORE adding evidence: a live proposer would
+        # commit the evidence into its own next block within ~one round,
+        # draining it from the pending list before the gossip tick fires
+        # (that fast path is exactly what
+        # test_equivocation_lands_in_committed_block covers)
+        await cs_a.stop()
+        blk = a[2].load_block(1)
+        parts = blk.make_part_set()
+        b[2].save_block(blk, parts, a[2].load_seen_commit(1))
+        b[3].save(a[3].load())
+        b[1]._state = a[3].load()
+
+        va, vb = _conflicting_votes(pvs[0], 0, height=1, ts=blk.header.time_ns)
+        ev = DuplicateVoteEvidence.from_votes(
+            va, vb, vs.total_voting_power(), 10, blk.header.time_ns
+        )
+        a[1]._state = a[3].load()
+        a[1].add_evidence(ev)
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if b[1].size() > 0:
+                break
+        got = b[1].size()
+        for n in (a, b):
+            await n[6].stop()
+        return got
+
+    assert asyncio.run(run()) == 1, "evidence did not gossip to peer"

@@ -1,0 +1,177 @@
+"""Microbench candidate field-mul formulations on the current backend.
+
+Run on TPU (default) or CPU (JAX_PLATFORMS=cpu). Times one batched field
+multiplication (convolution + fold + carries) for several designs:
+
+  A. batch-minor [B, 32] radix-2^8 int32 (current design)
+  B. limb-major [32, B] radix-2^8 int32
+  C. limb-major [20, B] radix-2^13 int32
+  D. limb-major [32, B] radix-2^8 f32 (exact: products < 2^18, sums < 2^23)
+  E. MXU dot: [B,32] bf16 x shared one-hot -> conv via dot_general f32
+
+Prints per-candidate: time per mul at B, and extrapolated Mmul/s.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+ITERS = 20
+
+
+def timeit(fn, *args):
+    fn_j = jax.jit(fn)
+    out = jax.block_until_ready(fn_j(*args))  # compile+warm
+    best = float("inf")
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn_j(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def report(name, dt, nmul=1):
+    print(f"{name:40s} {dt*1e6:10.1f} us/call  {B*nmul/dt/1e6:8.2f} Mmul/s")
+
+
+# --- A: batch-minor [B, 32] radix-2^8 (current) ---------------------------
+
+def mul_a(a, b):
+    out = jnp.zeros((a.shape[0], 63), dtype=jnp.int32)
+    for i in range(32):
+        out = out.at[:, i : i + 32].add(a[:, i : i + 1] * b)
+    lo, hi = out[:, :32], out[:, 32:]
+    x = lo.at[:, :31].add(hi * 38)
+    for _ in range(4):
+        c = x >> 8
+        r = x - (c << 8)
+        x = r + jnp.concatenate([c[:, 31:] * 38, c[:, :31]], axis=1)
+    return x
+
+
+# --- B: limb-major [32, B] radix-2^8 --------------------------------------
+
+def mul_b(a, b):
+    out = jnp.zeros((63, a.shape[1]), dtype=jnp.int32)
+    for i in range(32):
+        out = out.at[i : i + 32, :].add(a[i : i + 1, :] * b)
+    lo, hi = out[:32], out[32:]
+    x = lo.at[:31].add(hi * 38)
+    for _ in range(4):
+        c = x >> 8
+        r = x - (c << 8)
+        x = r + jnp.concatenate([c[31:] * 38, c[:31]], axis=0)
+    return x
+
+
+# --- C: limb-major [20, B] radix-2^13 -------------------------------------
+# p = 2^255-19; 20 limbs x 13 bits = 260 bits; 2^260 = 32*2^255 = 32*19+...
+# fold: 2^260 ≡ 608 (mod p). hi columns carried once before folding.
+
+def mul_c(a, b):
+    out = jnp.zeros((39, a.shape[1]), dtype=jnp.int32)
+    for i in range(20):
+        out = out.at[i : i + 20, :].add(a[i : i + 1, :] * b)
+    # carry hi part once so hi*608 stays in int32
+    hi = out[20:]
+    c = hi >> 13
+    hi = hi - (c << 13)
+    # fold: limb k (k>=20) contributes limb_{k-20} * 608; carries go up
+    x = out[:20].at[:19].add(hi * 608)
+    x = x.at[0].add(c[-1] * 0)  # keep shape; top carry folded below
+    carries = jnp.concatenate([jnp.zeros((1, a.shape[1]), jnp.int32), c], axis=0)[:20]
+    x = x + carries * 0  # placeholder: approximate op count
+    for _ in range(3):
+        c2 = x >> 13
+        r = x - (c2 << 13)
+        x = r + jnp.concatenate([c2[19:] * 608, c2[:19]], axis=0)
+    return x
+
+
+# --- D: limb-major [32, B] radix-2^8 float32 ------------------------------
+
+def mul_d(a, b):
+    out = jnp.zeros((63, a.shape[1]), dtype=jnp.float32)
+    for i in range(32):
+        out = out.at[i : i + 32, :].add(a[i : i + 1, :] * b)
+    lo, hi = out[:32], out[32:]
+    x = lo.at[:31].add(hi * 38.0)
+    for _ in range(4):
+        c = jnp.floor(x * (1.0 / 256.0))
+        r = x - c * 256.0
+        x = r + jnp.concatenate([c[31:] * 38.0, c[:31]], axis=0)
+    return x
+
+
+# --- E: conv via shared-matrix dot (MXU attempt) --------------------------
+# out[b, k] = sum_ij a[b,i] b[b,j] [i+j=k]: build outer via broadcast then
+# contract the flattened 1024 dim against a constant one-hot [1024, 63].
+
+_SEL = np.zeros((32 * 32, 63), dtype=np.float32)
+for i in range(32):
+    for j in range(32):
+        _SEL[i * 32 + j, i + j] = 1.0
+
+
+def mul_e(a, b):
+    outer = (a[:, :, None] * b[:, None, :]).reshape(a.shape[0], 1024)
+    out = jax.lax.dot_general(
+        outer, jnp.asarray(_SEL),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    lo, hi = out[:, :32], out[:, 32:]
+    x = lo.at[:, :31].add(hi * 38.0)
+    for _ in range(4):
+        c = jnp.floor(x * (1.0 / 256.0))
+        r = x - c * 256.0
+        x = r + jnp.concatenate([c[:, 31:] * 38.0, c[:, :31]], axis=1)
+    return x
+
+
+def main():
+    print(f"backend={jax.default_backend()} devices={len(jax.devices())} B={B}")
+    rng = np.random.default_rng(0)
+    a8 = rng.integers(0, 256, (B, 32), dtype=np.int32)
+    b8 = rng.integers(0, 256, (B, 32), dtype=np.int32)
+
+    dt, _ = timeit(mul_a, jnp.asarray(a8), jnp.asarray(b8))
+    report("A [B,32] r8 int32 (current)", dt)
+    dt, _ = timeit(mul_b, jnp.asarray(a8.T), jnp.asarray(b8.T))
+    report("B [32,B] r8 int32", dt)
+    a13 = rng.integers(0, 1 << 13, (20, B), dtype=np.int32)
+    b13 = rng.integers(0, 1 << 13, (20, B), dtype=np.int32)
+    dt, _ = timeit(mul_c, jnp.asarray(a13), jnp.asarray(b13))
+    report("C [20,B] r13 int32", dt)
+    dt, _ = timeit(mul_d, jnp.asarray(a8.T, dtype=np.float32), jnp.asarray(b8.T, dtype=np.float32))
+    report("D [32,B] r8 f32", dt)
+    dt, _ = timeit(mul_e, jnp.asarray(a8, dtype=np.float32), jnp.asarray(b8, dtype=np.float32))
+    report("E [B,32] r8 f32 outer+dot", dt)
+
+    # chain of 16 muls: measures fusion/memory behavior, closer to real use
+    def chain_b(a, b):
+        x = a
+        for _ in range(16):
+            x = mul_b(x & 0xFF, b)
+        return x
+
+    dt, _ = timeit(chain_b, jnp.asarray(a8.T), jnp.asarray(b8.T))
+    report("B chain x16", dt, nmul=16)
+
+    def chain_d(a, b):
+        x = a
+        for _ in range(16):
+            x = mul_d(x - jnp.floor(x * (1/256.)) * 256., b)
+        return x
+
+    dt, _ = timeit(chain_d, jnp.asarray(a8.T, dtype=np.float32), jnp.asarray(b8.T, dtype=np.float32))
+    report("D chain x16", dt, nmul=16)
+
+
+if __name__ == "__main__":
+    main()
