@@ -868,9 +868,6 @@ class ConsensusState:
             self.logger.info("bad vote", err=repr(e))
             return False
 
-    def _vote_in_valset(self, vote: Vote) -> bool:
-        return self.state.validators.has_address(vote.validator_address)
-
     async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
         """addVote (reference :2274-2519)."""
         rs = self.rs

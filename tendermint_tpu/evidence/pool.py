@@ -152,14 +152,30 @@ class EvidencePool:
             # common_height < conflicting height; reference verify.go:60-90)
             from ..types.block import Header
 
-            conflict_h = Header.decode(ev.conflicting_header).height
+            try:
+                conflict_header = Header.decode(ev.conflicting_header)
+            except Exception as e:
+                raise ValueError(
+                    f"malformed light-client-attack evidence: {e}"
+                ) from e
+            conflict_h = conflict_header.height
             trusted = (
                 meta
                 if conflict_h == ev.height()
                 else self._block_store.load_block_meta(conflict_h)
             )
             if trusted is None:
-                raise ValueError(f"don't have header #{conflict_h}")
+                # forward lunatic attack: the forged header sits above our
+                # head (or at a pruned height) — judge against our latest
+                # header instead (reference verify.go:76-90)
+                latest_h = self._block_store.height()
+                trusted = self._block_store.load_block_meta(latest_h)
+                if trusted is None:
+                    raise ValueError(f"don't have header #{conflict_h}")
+                if trusted.header.time_ns < conflict_header.time_ns:
+                    raise ValueError(
+                        "latest block time is before conflicting block time"
+                    )
             verify_light_client_attack(
                 ev,
                 common_vals,

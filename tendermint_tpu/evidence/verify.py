@@ -77,9 +77,16 @@ def verify_light_client_attack(
     """
     from ..types.block import Commit, Header
 
-    header = Header.decode(ev.conflicting_header)
-    commit = Commit.decode(ev.conflicting_commit)
-    conflicting_vals = ValidatorSet.decode(ev.conflicting_validators)
+    try:
+        header = Header.decode(ev.conflicting_header)
+        commit = Commit.decode(ev.conflicting_commit)
+        conflicting_vals = ValidatorSet.decode(ev.conflicting_validators)
+    except Exception as e:
+        # decode failures (EOFError from truncated protos, etc.) must surface
+        # as invalid-evidence ValueErrors: this path is reachable from a
+        # byzantine proposer via block validation and must never crash the
+        # consensus step
+        raise ValueError(f"malformed light-client-attack evidence: {e}") from e
 
     # the commit must actually be FOR the conflicting header — otherwise a
     # real commit for the canonical block + a fabricated header would pass

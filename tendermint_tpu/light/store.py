@@ -1,0 +1,61 @@
+"""Trusted light-block store (reference light/store/db/db.go).
+
+KV-backed, height-indexed, pruned to a bounded size. The store IS the
+light client's checkpoint: restart resumes from the latest trusted block.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .types import LightBlock
+
+_PREFIX = b"lb/"
+
+
+def _key(height: int) -> bytes:
+    return _PREFIX + height.to_bytes(8, "big")
+
+
+class LightStore:
+    def __init__(self, kv):
+        self._kv = kv
+
+    def save(self, lb: LightBlock) -> None:
+        self._kv.set(_key(lb.height), lb.encode())
+
+    def get(self, height: int) -> Optional[LightBlock]:
+        data = self._kv.get(_key(height))
+        return LightBlock.decode(data) if data is not None else None
+
+    def latest(self) -> Optional[LightBlock]:
+        last = None
+        for _k, v in self._kv.iterate(_PREFIX, _PREFIX + b"\xff" * 9):
+            last = v
+        return LightBlock.decode(last) if last is not None else None
+
+    def first(self) -> Optional[LightBlock]:
+        for _k, v in self._kv.iterate(_PREFIX, _PREFIX + b"\xff" * 9):
+            return LightBlock.decode(v)
+        return None
+
+    def heights(self) -> list[int]:
+        return [
+            int.from_bytes(k[len(_PREFIX):], "big")
+            for k, _v in self._kv.iterate(_PREFIX, _PREFIX + b"\xff" * 9)
+        ]
+
+    def delete(self, height: int) -> None:
+        self._kv.delete(_key(height))
+
+    def prune(self, keep: int) -> None:
+        """Delete oldest blocks beyond `keep` (reference Prune)."""
+        hs = self.heights()
+        for h in hs[: max(0, len(hs) - keep)]:
+            self.delete(h)
+
+    def delete_after(self, height: int) -> None:
+        """Remove all blocks above `height` (fork cleanup)."""
+        for h in self.heights():
+            if h > height:
+                self.delete(h)

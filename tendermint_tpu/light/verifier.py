@@ -107,9 +107,18 @@ def verify(
     untrusted: LightBlock,
     trusting_period_ns: int,
     now_ns: int,
+    max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
 ) -> None:
     """Dispatch (reference Verify :135)."""
     if untrusted.height == trusted.height + 1:
-        verify_adjacent(trusted, untrusted, trusting_period_ns, now_ns)
+        verify_adjacent(
+            trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns
+        )
     else:
-        verify_non_adjacent(trusted, untrusted, trusting_period_ns, now_ns)
+        verify_non_adjacent(
+            trusted,
+            untrusted,
+            trusting_period_ns,
+            now_ns,
+            max_clock_drift_ns=max_clock_drift_ns,
+        )

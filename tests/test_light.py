@@ -1,0 +1,262 @@
+"""Light client: bisection, sequential, backwards, detector, providers.
+
+Mirrors the reference suite (light/client_test.go 20 tests + detector_test.go
+7 tests) in compressed form over an in-memory chain generator.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.light import LightBlock, LightClient, TrustOptions
+from tendermint_tpu.light.client import (
+    ErrLightClientAttack,
+    LightClientError,
+)
+from tendermint_tpu.light.store import LightStore
+from tendermint_tpu.store.kv import MemKV
+from tendermint_tpu.types.block import Header
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.priv_validator import MockPV
+from tendermint_tpu.types.vote import Vote, VoteType
+from tendermint_tpu.types.vote_set import VoteSet
+
+CHAIN_ID = "light-chain"
+T0 = 1_700_000_000 * 1_000_000_000
+BLOCK_NS = 1_000_000_000  # 1s blocks
+PERIOD = 3600 * 1_000_000_000  # 1h trusting period
+
+
+def make_chain(n, n_vals=4, seed=b"light", fork_at=None, fork_seed=b"fork"):
+    """n LightBlocks with a static validator set; optionally fork from
+    height `fork_at` (different app hashes => different header hashes)."""
+    pvs = [MockPV.from_secret(seed + b"%d" % i) for i in range(n_vals)]
+    vs = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+
+    blocks = []
+    last_id = BlockID()
+    for h in range(1, n + 1):
+        forked = fork_at is not None and h >= fork_at
+        header = Header(
+            chain_id=CHAIN_ID,
+            height=h,
+            time_ns=T0 + h * BLOCK_NS,
+            last_block_id=last_id,
+            validators_hash=vs.hash(),
+            next_validators_hash=vs.hash(),
+            app_hash=(fork_seed if forked else b"app") + b"-%d" % h,
+            proposer_address=vs.validators[0].address,
+        )
+        bid = BlockID(header.hash(), part_set_header=__import__(
+            "tendermint_tpu.types.part_set", fromlist=["PartSetHeader"]
+        ).PartSetHeader(1, header.hash()))
+        votes = VoteSet(CHAIN_ID, h, 0, VoteType.PRECOMMIT, vs)
+        for i, pv in enumerate(ordered):
+            v = Vote(
+                type=VoteType.PRECOMMIT,
+                height=h,
+                round=0,
+                block_id=bid,
+                timestamp_ns=header.time_ns,
+                validator_address=pv.get_pub_key().address(),
+                validator_index=i,
+            )
+            pv.sign_vote(CHAIN_ID, v)
+            votes.add_vote(v, verified=True)
+        blocks.append(LightBlock(header, votes.make_commit(), vs))
+        last_id = bid
+    return blocks
+
+
+class MockProvider:
+    def __init__(self, blocks, name="primary", fail_heights=()):
+        self.blocks = {b.height: b for b in blocks}
+        self.name = name
+        self.fail_heights = set(fail_heights)
+        self.requests = []
+
+    async def light_block(self, height):
+        if height == 0:
+            height = max(self.blocks)
+        self.requests.append(height)
+        if height in self.fail_heights:
+            return None
+        return self.blocks.get(height)
+
+    def id(self):
+        return self.name
+
+
+def make_client(chain, *, witnesses=None, store=None, now=None, **kw):
+    primary = MockProvider(chain)
+    witnesses = witnesses if witnesses is not None else [
+        MockProvider(chain, name="witness-0")
+    ]
+    store = store or LightStore(MemKV())
+    trust = TrustOptions(PERIOD, 1, chain[0].header.hash())
+    return LightClient(
+        CHAIN_ID,
+        trust,
+        primary,
+        witnesses,
+        store,
+        now_ns=now or (lambda: T0 + 200 * BLOCK_NS),
+        **kw,
+    )
+
+
+def test_bisection_verifies_distant_header():
+    chain = make_chain(100)
+    c = make_client(chain)
+    lb = asyncio.run(c.verify_light_block_at_height(100))
+    assert lb.height == 100
+    # bisection must NOT fetch every height (static valset -> direct jump)
+    assert len(c.primary.requests) < 20
+    assert c.last_trusted_height() == 100
+
+
+def test_sequential_verifies_every_header():
+    chain = make_chain(10)
+    c = make_client(chain, sequential=True)
+    lb = asyncio.run(c.verify_light_block_at_height(10))
+    assert lb.height == 10
+    assert len([h for h in c.primary.requests if h <= 10]) >= 9
+
+
+def test_expired_trusting_period_rejected():
+    chain = make_chain(10)
+    # now is far beyond T0 + period
+    c = make_client(chain, now=lambda: T0 + PERIOD + 1000 * BLOCK_NS)
+    with pytest.raises((LightClientError, Exception)):
+        asyncio.run(c.verify_light_block_at_height(10))
+
+
+def test_backwards_verification():
+    chain = make_chain(50)
+    c = make_client(chain)
+    asyncio.run(c.verify_light_block_at_height(50))
+    lb = asyncio.run(c.verify_light_block_at_height(20))
+    assert lb.height == 20
+    # hash-chain walked down from 50
+    assert c.store.get(20) is not None
+
+
+def test_detector_catches_forked_primary():
+    """Primary serves a forked chain; honest witness diverges -> the
+    client must detect the fork and surface attack evidence
+    (reference detector_test.go TestLightClientAttackEvidence)."""
+    honest = make_chain(40)
+    forked = make_chain(40, fork_at=21)
+    # primary is byzantine (forked), witness honest: common prefix 1..20
+    store = LightStore(MemKV())
+    trust = TrustOptions(PERIOD, 1, honest[0].header.hash())
+    c = LightClient(
+        CHAIN_ID,
+        trust,
+        MockProvider(forked, name="byzantine-primary"),
+        [MockProvider(honest, name="honest-witness")],
+        store,
+        now_ns=lambda: T0 + 200 * BLOCK_NS,
+    )
+    with pytest.raises(ErrLightClientAttack) as ei:
+        asyncio.run(c.verify_light_block_at_height(40))
+    ev = ei.value.evidence
+    assert ev.common_height <= 20
+    assert ev.total_voting_power == 40
+    # the evidence must package the PRIMARY's forked block (the one honest
+    # full nodes will find conflicting), not the witness's honest block
+    conflicting = Header.decode(ev.conflicting_header)
+    assert conflicting.hash() != honest[conflicting.height - 1].header.hash()
+    assert (
+        conflicting.hash() == forked[conflicting.height - 1].header.hash()
+    )
+
+
+def test_conflicting_witness_at_trust_root_is_hard_error():
+    """A witness that disagrees at the trust root is a misconfiguration
+    (reference compareFirstHeaderWithWitnesses :1156 returns the error)."""
+    chain = make_chain(30)
+    garbage = make_chain(30, seed=b"other")  # different chain entirely
+    c = make_client(
+        chain, witnesses=[MockProvider(garbage, name="bad-witness")]
+    )
+    with pytest.raises(LightClientError, match="trust root"):
+        asyncio.run(c.verify_light_block_at_height(30))
+
+
+def test_bad_witness_removed_good_witness_matches():
+    """A witness serving an unverifiable conflicting block is removed;
+    the good witness cross-references fine (reference detector.go:76-83)."""
+    import copy
+
+    chain = make_chain(30)
+    bad_chain = list(chain)
+    # corrupt the tip: header tampered, commit no longer signs it
+    tampered = copy.deepcopy(chain[29])
+    tampered.header.app_hash = b"tampered"
+    tampered.header._hash = None  # invalidate the cached header hash
+    bad_chain[29] = tampered
+    c = make_client(
+        chain,
+        witnesses=[
+            MockProvider(bad_chain, name="bad-witness"),
+            MockProvider(chain, name="good-witness"),
+        ],
+    )
+    lb = asyncio.run(c.verify_light_block_at_height(30))
+    assert lb.height == 30
+    assert [w.id() for w in c.witnesses] == ["good-witness"]
+
+
+def test_primary_replaced_when_missing_blocks():
+    chain = make_chain(30)
+    primary = MockProvider(chain, fail_heights={30})
+    store = LightStore(MemKV())
+    trust = TrustOptions(PERIOD, 1, chain[0].header.hash())
+    c = LightClient(
+        CHAIN_ID,
+        trust,
+        primary,
+        [
+            MockProvider(chain, name="witness-0"),
+            MockProvider(chain, name="witness-1"),
+        ],
+        store,
+        now_ns=lambda: T0 + 200 * BLOCK_NS,
+    )
+    lb = asyncio.run(c.verify_light_block_at_height(30))
+    assert lb.height == 30
+    assert c.primary.id() == "witness-0"
+    # the demoted primary joined the witness set
+    assert "primary" in [w.id() for w in c.witnesses]
+
+
+def test_store_pruning_bounds_size():
+    chain = make_chain(60)
+    c = make_client(chain, pruning_size=5, sequential=True)
+    asyncio.run(c.verify_light_block_at_height(60))
+    assert len(c.store.heights()) <= 5
+    assert c.last_trusted_height() == 60
+
+
+def test_restart_resumes_from_store():
+    chain = make_chain(20)
+    kv = MemKV()
+    c1 = make_client(chain, store=LightStore(kv))
+    asyncio.run(c1.verify_light_block_at_height(20))
+    # new client over the same kv, no trust options needed
+    c2 = LightClient(
+        CHAIN_ID,
+        None,
+        MockProvider(chain),
+        [MockProvider(chain, name="w")],
+        LightStore(kv),
+        trusting_period_ns=PERIOD,
+        now_ns=lambda: T0 + 200 * BLOCK_NS,
+    )
+    lb = asyncio.run(c2.initialize())
+    assert lb.height == 20

@@ -147,7 +147,10 @@ def main():
     a13 = rng.integers(0, 1 << 13, (20, B), dtype=np.int32)
     b13 = rng.integers(0, 1 << 13, (20, B), dtype=np.int32)
     dt, _ = timeit(mul_c, jnp.asarray(a13), jnp.asarray(b13))
-    report("C [20,B] r13 int32", dt)
+    # NOTE: C's fold uses *0 placeholder terms that XLA constant-folds
+    # away, so this row is a LOWER BOUND on the real radix-13 cost, not a
+    # faithful implementation.
+    report("C [20,B] r13 int32 (lower bound)", dt)
     dt, _ = timeit(mul_d, jnp.asarray(a8.T, dtype=np.float32), jnp.asarray(b8.T, dtype=np.float32))
     report("D [32,B] r8 f32", dt)
     dt, _ = timeit(mul_e, jnp.asarray(a8, dtype=np.float32), jnp.asarray(b8, dtype=np.float32))
