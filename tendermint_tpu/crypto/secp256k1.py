@@ -207,10 +207,12 @@ def sign_digest(digest: bytes, secret: int, recoverable: bool = False) -> bytes:
         s = _inv(k, N) * (z + r * secret) % N
         if s == 0:
             continue
-        rec_id = (pt[1] & 1) ^ (1 if pt[0] >= N else 0)
+        # standard recid: bit 0 = parity of the nonce point's y, bit 1 =
+        # x overflowed the group order (recover lifts x = r + N*(v>>1))
+        rec_id = (pt[1] & 1) | (2 if pt[0] >= N else 0)
         if s > _HALF_N:
             s = N - s
-            rec_id ^= 1
+            rec_id ^= 1  # negating s flips only the y parity
         out = r.to_bytes(32, "big") + s.to_bytes(32, "big")
         if recoverable:
             out += bytes([rec_id])

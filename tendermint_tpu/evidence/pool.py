@@ -28,6 +28,16 @@ _PENDING = b"\x00"
 _COMMITTED = b"\x01"
 
 
+class BenignEvidenceError(ValueError):
+    """Evidence we cannot judge or no longer care about — NOT an attack.
+
+    Raised when verification fails for reasons local to this node (missing
+    historical data because we are behind or pruned, or the evidence aged
+    out between the sender's sweep and ours). The reactor must not punish
+    peers for these (reference evidence/reactor.go only disconnects on
+    ErrInvalidEvidence)."""
+
+
 def _key(prefix: bytes, height: int, ev_hash: bytes) -> bytes:
     return prefix + height.to_bytes(8, "big") + ev_hash
 
@@ -123,7 +133,7 @@ class EvidencePool:
 
         meta = self._block_store.load_block_meta(ev.height())
         if meta is None:
-            raise ValueError(f"don't have header #{ev.height()}")
+            raise BenignEvidenceError(f"don't have header #{ev.height()}")
         ev_time = meta.header.time_ns
         if ev.timestamp_ns != ev_time:
             raise ValueError(
@@ -134,19 +144,19 @@ class EvidencePool:
             age_ns > params.max_age_duration_ns
             and age_blocks > params.max_age_num_blocks
         ):
-            raise ValueError(f"evidence from height {ev.height()} is too old")
+            raise BenignEvidenceError(f"evidence from height {ev.height()} is too old")
 
         if isinstance(ev, DuplicateVoteEvidence):
             vals = self._state_store.load_validators(ev.height())
             if vals is None:
-                raise ValueError(f"no validator set at height {ev.height()}")
+                raise BenignEvidenceError(f"no validator set at height {ev.height()}")
             verify_duplicate_vote(
                 ev, state.chain_id, vals, verifier=self._verifier
             )
         elif isinstance(ev, LightClientAttackEvidence):
             common_vals = self._state_store.load_validators(ev.height())
             if common_vals is None:
-                raise ValueError(f"no validator set at height {ev.height()}")
+                raise BenignEvidenceError(f"no validator set at height {ev.height()}")
             # the trusted header to differ from is the one at the
             # CONFLICTING block's height (lunatic attacks have
             # common_height < conflicting height; reference verify.go:60-90)
@@ -171,7 +181,7 @@ class EvidencePool:
                 latest_h = self._block_store.height()
                 trusted = self._block_store.load_block_meta(latest_h)
                 if trusted is None:
-                    raise ValueError(f"don't have header #{conflict_h}")
+                    raise BenignEvidenceError(f"don't have header #{conflict_h}")
                 if trusted.header.time_ns < conflict_header.time_ns:
                     raise ValueError(
                         "latest block time is before conflicting block time"

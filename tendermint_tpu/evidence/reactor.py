@@ -17,7 +17,7 @@ from ..p2p.mconn import ChannelDescriptor
 from ..p2p.switch import Reactor
 from ..p2p.transport import Peer
 from ..types.evidence import decode_evidence
-from .pool import EvidencePool
+from .pool import BenignEvidenceError, EvidencePool
 
 EVIDENCE_CHANNEL = 0x38
 _BROADCAST_INTERVAL = 0.5  # reference: peerRetryMessageIntervalMS-ish pacing
@@ -70,20 +70,16 @@ class EvidenceReactor(Reactor):
         for ev in evs:
             try:
                 self.pool.add_evidence(ev)
+            except BenignEvidenceError as e:
+                # we are behind / pruned / the evidence just aged out —
+                # never punish a peer for evidence we can't judge (the
+                # reference only disconnects on ErrInvalidEvidence,
+                # evidence/reactor.go:87-99)
+                self.logger.info("cannot verify evidence", err=str(e))
+                continue
             except ValueError as e:
-                # Only cryptographically-invalid evidence is punishable.
-                # "don't have header #N" just means WE are behind (the
-                # reference only disconnects on ErrInvalidEvidence and logs
-                # everything else, evidence/reactor.go:87-99) — punishing it
-                # would sever the very peers a lagging node syncs from.
-                msg_s = str(e)
-                if "don't have header" in msg_s or "no validator set" in msg_s:
-                    self.logger.info(
-                        "cannot verify evidence yet", err=msg_s
-                    )
-                    continue
                 self.logger.info(
-                    "peer sent invalid evidence", peer=peer.id, err=msg_s
+                    "peer sent invalid evidence", peer=peer.id, err=str(e)
                 )
                 await self.switch.stop_peer_for_error(
                     peer, f"invalid evidence: {e}"

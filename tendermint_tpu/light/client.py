@@ -389,9 +389,11 @@ class LightClient:
         if lb is not None:
             lb.validate_basic(self.chain_id)
             return lb
-        # replace the primary from the witness set (reference :1046)
-        while self.witnesses:
-            candidate = self.witnesses.pop(0)
+        # Replace the primary from the witness set (reference :1046).
+        # Witnesses that merely don't have the block are NOT removed — a
+        # transient availability blip must not destroy the witness set the
+        # fork detector depends on.
+        for i, candidate in enumerate(self.witnesses):
             try:
                 lb = await candidate.light_block(height)
             except Exception:
@@ -400,8 +402,9 @@ class LightClient:
                 self.logger.info(
                     "replaced primary", new_primary=candidate.id()
                 )
-                self.witnesses.append(self.primary)
+                old_primary = self.primary
                 self.primary = candidate
+                self.witnesses[i] = old_primary
                 lb.validate_basic(self.chain_id)
                 return lb
         raise LightClientError(f"no provider has block at height {height}")

@@ -2,6 +2,9 @@
 
 KV-backed, height-indexed, pruned to a bounded size. The store IS the
 light client's checkpoint: restart resumes from the latest trusted block.
+The height index is cached in memory (one scan at construction) so
+latest()/prune() on the verify hot path don't re-scan the KV range —
+the bisection bulk workload calls them per verified height.
 """
 
 from __future__ import annotations
@@ -11,6 +14,7 @@ from typing import Optional
 from .types import LightBlock
 
 _PREFIX = b"lb/"
+_END = _PREFIX + b"\xff" * 9
 
 
 def _key(height: int) -> bytes:
@@ -20,42 +24,47 @@ def _key(height: int) -> bytes:
 class LightStore:
     def __init__(self, kv):
         self._kv = kv
+        self._heights: list[int] = sorted(
+            int.from_bytes(k[len(_PREFIX):], "big")
+            for k, _v in kv.iterate(_PREFIX, _END)
+        )
 
     def save(self, lb: LightBlock) -> None:
         self._kv.set(_key(lb.height), lb.encode())
+        if not self._heights or lb.height > self._heights[-1]:
+            self._heights.append(lb.height)
+        elif lb.height not in self._heights:
+            import bisect
+
+            bisect.insort(self._heights, lb.height)
 
     def get(self, height: int) -> Optional[LightBlock]:
         data = self._kv.get(_key(height))
         return LightBlock.decode(data) if data is not None else None
 
     def latest(self) -> Optional[LightBlock]:
-        last = None
-        for _k, v in self._kv.iterate(_PREFIX, _PREFIX + b"\xff" * 9):
-            last = v
-        return LightBlock.decode(last) if last is not None else None
+        return self.get(self._heights[-1]) if self._heights else None
 
     def first(self) -> Optional[LightBlock]:
-        for _k, v in self._kv.iterate(_PREFIX, _PREFIX + b"\xff" * 9):
-            return LightBlock.decode(v)
-        return None
+        return self.get(self._heights[0]) if self._heights else None
 
     def heights(self) -> list[int]:
-        return [
-            int.from_bytes(k[len(_PREFIX):], "big")
-            for k, _v in self._kv.iterate(_PREFIX, _PREFIX + b"\xff" * 9)
-        ]
+        return list(self._heights)
 
     def delete(self, height: int) -> None:
         self._kv.delete(_key(height))
+        try:
+            self._heights.remove(height)
+        except ValueError:
+            pass
 
     def prune(self, keep: int) -> None:
         """Delete oldest blocks beyond `keep` (reference Prune)."""
-        hs = self.heights()
-        for h in hs[: max(0, len(hs) - keep)]:
+        excess = len(self._heights) - keep
+        for h in list(self._heights[:max(0, excess)]):
             self.delete(h)
 
     def delete_after(self, height: int) -> None:
         """Remove all blocks above `height` (fork cleanup)."""
-        for h in self.heights():
-            if h > height:
-                self.delete(h)
+        for h in [h for h in self._heights if h > height]:
+            self.delete(h)

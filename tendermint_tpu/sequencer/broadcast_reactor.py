@@ -177,14 +177,17 @@ class BlockBroadcastReactor(Reactor):
             if kind == _BLOCK_REQUEST:
                 await self._on_block_request(height, peer)
             elif kind == _BLOCK_RESPONSE_V2 and block is not None:
-                # only heights we actually requested skip signature
-                # verification; anything unsolicited goes through the
-                # signed path so a rogue peer can't push unsigned blocks
-                if block.number in self.requested_heights:
-                    self.requested_heights.discard(block.number)
-                    await self._on_block_v2(block, peer, verify_sig=False)
-                else:
-                    await self._on_block_v2(block, peer, verify_sig=True)
+                # Sync responses are signature-verified like broadcasts.
+                # (The reference skips verification here as a shortcut, but
+                # every sequencer block IS signed, and an unverified path
+                # would let whichever peer answered a request — or pushed
+                # an unsolicited response — extend our chain with forged
+                # blocks. Requested heights only bypass the seen-dedup.)
+                requested = block.number in self.requested_heights
+                self.requested_heights.discard(block.number)
+                await self._on_block_v2(
+                    block, peer, verify_sig=True, dedup=not requested
+                )
             elif kind == _STATUS:
                 self.peer_heights[peer.id] = height
             # _NO_BLOCK_RESPONSE: nothing to do (logged by reference too)
@@ -224,10 +227,10 @@ class BlockBroadcastReactor(Reactor):
     # --- core logic (broadcast_reactor.go:251-316) ---------------------------
 
     async def _on_block_v2(
-        self, block: BlockV2, src: Peer, verify_sig: bool
+        self, block: BlockV2, src: Peer, verify_sig: bool, dedup: bool = True
     ) -> None:
-        if self.seen_blocks.add(block.hash) and verify_sig:
-            return  # broadcast dedup; sync responses bypass dedup
+        if self.seen_blocks.add(block.hash) and dedup:
+            return  # broadcast dedup; requested sync responses bypass dedup
         self.peer_sent.add(src.id, block.hash)
         self.peer_heights[src.id] = max(
             self.peer_heights.get(src.id, 0), block.number
@@ -245,11 +248,15 @@ class BlockBroadcastReactor(Reactor):
                 )
                 return
             except Exception as e:
+                # also un-poison on content/apply failures: the signature
+                # covers only the 32-byte hash, so a relayed copy with
+                # tampered contents passes _verify_signature but fails in
+                # the execution layer — the genuine copy of this hash must
+                # still be acceptable later
+                self.seen_blocks.discard(block.hash)
                 self.logger.error(
                     "apply failed", number=block.number, err=str(e)
                 )
-                if verify_sig:
-                    self.pending_cache.add(block, local_height)
                 return
             if verify_sig:
                 self._gossip_block(block, from_peer=src.id)

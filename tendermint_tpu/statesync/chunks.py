@@ -1,0 +1,85 @@
+"""Chunk queue for an in-flight snapshot restore.
+
+Reference: statesync/chunks.go — the reference spools chunks to a temp
+dir; chunks here are small enough to keep in memory (the app re-chunks
+however it likes). Tracks allocation (which chunk is being fetched from
+which peer), arrival, and retry/refetch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class Chunk:
+    height: int
+    format: int
+    index: int
+    chunk: bytes
+    sender: str = ""
+
+
+class ChunkQueue:
+    def __init__(self, num_chunks: int):
+        self.num_chunks = num_chunks
+        self._chunks: dict[int, Chunk] = {}
+        self._allocated: dict[int, str] = {}  # index -> peer fetching it
+        self._event = asyncio.Event()
+        self._closed = False
+
+    def allocate(self) -> Optional[int]:
+        """Next chunk index to fetch, or None if all allocated/done."""
+        for i in range(self.num_chunks):
+            if i not in self._chunks and i not in self._allocated:
+                self._allocated[i] = ""
+                return i
+        return None
+
+    def add(self, chunk: Chunk) -> bool:
+        """Returns False for duplicates/out-of-range."""
+        if self._closed:
+            return False
+        if not (0 <= chunk.index < self.num_chunks):
+            return False
+        if chunk.index in self._chunks:
+            return False
+        self._chunks[chunk.index] = chunk
+        self._allocated.pop(chunk.index, None)
+        self._event.set()
+        return True
+
+    def get(self, index: int) -> Optional[Chunk]:
+        return self._chunks.get(index)
+
+    def retry(self, index: int) -> None:
+        """Put a chunk back for refetching (app asked for a refetch)."""
+        self._chunks.pop(index, None)
+        self._allocated.pop(index, None)
+
+    def discard_sender(self, peer_id: str) -> list[int]:
+        """Drop all chunks from a rejected sender; returns their indexes."""
+        dropped = []
+        for i, c in list(self._chunks.items()):
+            if c.sender == peer_id:
+                del self._chunks[i]
+                dropped.append(i)
+        return dropped
+
+    @property
+    def complete(self) -> bool:
+        return len(self._chunks) == self.num_chunks
+
+    async def wait_for_chunk(self, timeout: float = 10.0) -> bool:
+        """Wait until some chunk arrives (or timeout); clears the event."""
+        try:
+            await asyncio.wait_for(self._event.wait(), timeout)
+            self._event.clear()
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def close(self) -> None:
+        self._closed = True

@@ -1,0 +1,201 @@
+"""Statesync: chunk queue, syncer against the kvstore app, p2p bootstrap.
+
+Mirrors the reference suite shape (statesync/ 35 tests) in compressed form.
+"""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.statesync import ChunkQueue, StateSyncReactor, Syncer
+from tendermint_tpu.statesync.chunks import Chunk
+from tendermint_tpu.statesync.syncer import ErrNoSnapshots
+
+
+class FakePeer:
+    def __init__(self, pid="peer-0"):
+        self.id = pid
+        self.sent = []
+
+    def try_send(self, ch, msg):
+        self.sent.append((ch, msg))
+        return True
+
+
+# --- chunk queue -----------------------------------------------------------
+
+
+def test_chunk_queue_allocation_and_completion():
+    q = ChunkQueue(3)
+    assert q.allocate() == 0
+    assert q.allocate() == 1
+    assert q.allocate() == 2
+    assert q.allocate() is None
+    for i in range(3):
+        assert q.add(Chunk(1, 1, i, b"c%d" % i, sender="p"))
+    assert not q.add(Chunk(1, 1, 1, b"dup", sender="p"))  # duplicate
+    assert not q.add(Chunk(1, 1, 99, b"oob", sender="p"))  # out of range
+    assert q.complete
+
+
+def test_chunk_queue_retry_and_sender_discard():
+    q = ChunkQueue(3)
+    q.add(Chunk(1, 1, 0, b"a", sender="good"))
+    q.add(Chunk(1, 1, 1, b"b", sender="evil"))
+    q.add(Chunk(1, 1, 2, b"c", sender="evil"))
+    assert sorted(q.discard_sender("evil")) == [1, 2]
+    assert not q.complete
+    assert q.allocate() == 1  # freed for refetch
+
+
+# --- state provider + syncer over a real app ------------------------------
+
+
+class DirectStateProvider:
+    """Test double standing in for the light-client provider: serves the
+    trusted app hash / state / commit recorded from the source node."""
+
+    def __init__(self, app_hash, state=None, commit=None):
+        self._app_hash = app_hash
+        self._state = state
+        self._commit = commit
+
+    async def app_hash(self, height):
+        return self._app_hash
+
+    async def state(self, height):
+        return self._state
+
+    async def commit(self, height):
+        return self._commit
+
+
+def _run_source_app(n_txs=30):
+    """A kvstore app with some committed state + snapshots."""
+    app = KVStoreApplication()
+    app.SNAPSHOT_CHUNK_SIZE = 64  # force multiple chunks
+    for i in range(n_txs):
+        app.deliver_tx(b"key%d=value%d" % (i, i))
+        app.commit()
+    return app
+
+
+def test_syncer_restores_kvstore_snapshot():
+    src = _run_source_app()
+    snaps = src.list_snapshots()
+    assert snaps and snaps[-1].chunks > 1
+    snap = snaps[-1]
+
+    dst = KVStoreApplication()
+    dst.SNAPSHOT_CHUNK_SIZE = 64
+    provider = DirectStateProvider(
+        src.info().last_block_app_hash, state="STATE", commit="COMMIT"
+    )
+
+    sent_requests = []
+
+    def request_chunk(peer, height, fmt, index):
+        sent_requests.append(index)
+        # serve synchronously from the source app
+        data = src.load_snapshot_chunk(height, fmt, index)
+        syncer.add_chunk(Chunk(height, fmt, index, data, sender=peer.id))
+
+    syncer = Syncer(dst, provider, request_chunk)
+    peer = FakePeer()
+    assert syncer.add_snapshot(peer, snap)
+
+    async def run():
+        return await syncer.sync_any(discovery_time=0.1)
+
+    state, commit = asyncio.run(run())
+    assert state == "STATE" and commit == "COMMIT"
+    assert dst._state == src._state
+    assert dst.info().last_block_app_hash == src.info().last_block_app_hash
+    assert len(set(sent_requests)) == snap.chunks
+
+
+def test_syncer_rejects_corrupted_snapshot_then_no_snapshots():
+    src = _run_source_app()
+    snap = src.list_snapshots()[-1]
+    dst = KVStoreApplication()
+    dst.SNAPSHOT_CHUNK_SIZE = 64
+    provider = DirectStateProvider(b"\x00" * 32)  # wrong trusted hash
+
+    def request_chunk(peer, height, fmt, index):
+        data = src.load_snapshot_chunk(height, fmt, index)
+        syncer.add_chunk(Chunk(height, fmt, index, data, sender=peer.id))
+
+    syncer = Syncer(dst, provider, request_chunk)
+    syncer.add_snapshot(FakePeer(), snap)
+
+    async def run():
+        with pytest.raises(ErrNoSnapshots):
+            # the snapshot gets rejected (restored hash != trusted), and
+            # with no other snapshots and no discovery budget SyncAny bails
+            await syncer.sync_any(discovery_time=0)
+
+    asyncio.run(run())
+
+
+def test_statesync_over_p2p_bootstrap():
+    """Full path: fresh node discovers the snapshot over 0x60, fetches
+    chunks over 0x61, restores, and the app states match."""
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.p2p.node_info import NodeInfo
+    from tendermint_tpu.p2p.switch import Switch
+    from tendermint_tpu.p2p.transport import MultiplexTransport, NetAddress
+
+    src = _run_source_app()
+    dst = KVStoreApplication()
+    dst.SNAPSHOT_CHUNK_SIZE = 64
+    provider = DirectStateProvider(
+        src.info().last_block_app_hash, state="STATE", commit="COMMIT"
+    )
+
+    def build(app, syncer):
+        nk = NodeKey.generate()
+        transport = None
+        sw = None
+
+        def node_info():
+            return NodeInfo(
+                node_id=nk.id,
+                listen_addr=f"127.0.0.1:{transport.listen_port}",
+                network="ss-chain",
+                channels=sw.channels() if sw else b"",
+            )
+
+        transport = MultiplexTransport(nk, node_info)
+        sw = Switch(transport)
+        reactor = StateSyncReactor(app, syncer)
+        sw.add_reactor("statesync", reactor)
+        return reactor, nk, transport, sw
+
+    async def run():
+        server_r, server_nk, server_t, server_sw = build(src, None)
+        syncer_holder = []
+
+        def request_chunk(peer, height, fmt, index):
+            client_r.request_chunk(peer, height, fmt, index)
+
+        syncer = Syncer(dst, provider, request_chunk)
+        client_r, client_nk, client_t, client_sw = build(dst, syncer)
+        for t, sw in ((server_t, server_sw), (client_t, client_sw)):
+            await t.listen()
+            await sw.start()
+        await client_sw.dial_peer(
+            NetAddress(server_nk.id, "127.0.0.1", server_t.listen_port)
+        )
+        await asyncio.sleep(0.2)  # snapshot discovery round-trip
+        state, commit = await asyncio.wait_for(
+            syncer.sync_any(discovery_time=1.0), 20
+        )
+        for sw in (server_sw, client_sw):
+            await sw.stop()
+        return state, commit
+
+    state, commit = asyncio.run(run())
+    assert state == "STATE" and commit == "COMMIT"
+    assert dst._state == src._state

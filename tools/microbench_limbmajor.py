@@ -1,0 +1,128 @@
+"""Limb-major layout [..., 32, B]: batch fills the 128-lane minor dim.
+
+vs batch-major [B, ..., 32] where the 32-limb minor dim wastes 3/4 of the
+vector lanes. Same f32 arithmetic, same doubling chain.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+
+BIAS = np.full((32, 1), 1020.0, dtype=np.float32)
+BIAS[0, 0] = 872.0
+
+
+def carry(x):
+    # x: [..., 32, B]
+    c = jnp.floor(x * (1.0 / 256.0))
+    r = x - c * 256.0
+    wrap = jnp.concatenate([c[..., 31:, :] * 38.0, c[..., :31, :]], axis=-2)
+    return r + wrap
+
+
+def add(a, b):
+    return carry(a + b)
+
+
+def sub(a, b):
+    return carry(a + jnp.asarray(BIAS) - b)
+
+
+def mul(a, b):
+    # conv over the sublane (limb) axis: 32 shifted MACs of [63, B]
+    shape = (*a.shape[:-2], 63, a.shape[-1])
+    out = jnp.zeros(shape, dtype=jnp.float32)
+    for i in range(32):
+        out = out.at[..., i : i + 32, :].add(a[..., i : i + 1, :] * b)
+    lo = out[..., :32, :]
+    hi = out[..., 32:, :]
+    ch = jnp.floor(hi * (1.0 / 256.0))
+    rh = hi - ch * 256.0
+    z = jnp.zeros((*a.shape[:-2], 1, a.shape[-1]), jnp.float32)
+    hi2 = jnp.concatenate([rh, z], axis=-2) + jnp.concatenate(
+        [z, ch], axis=-2
+    )
+    x = lo + 38.0 * hi2
+    x = carry(x)
+    x = carry(x)
+    x = carry(x)
+    return carry(x)
+
+
+def sqr(x):
+    return mul(x, x)
+
+
+def mul_small(a, k):
+    x = a * float(k)
+    x = carry(x)
+    x = carry(x)
+    return carry(x)
+
+
+def double(p):
+    # p: [4, 32, B]
+    x1, y1, z1 = p[0], p[1], p[2]
+    xx = sqr(x1)
+    yy = sqr(y1)
+    b2 = mul_small(sqr(z1), 2)
+    aa = sqr(add(x1, y1))
+    y3 = add(yy, xx)
+    z3 = sub(yy, xx)
+    x3 = sub(aa, y3)
+    t3 = sub(b2, z3)
+    return jnp.stack(
+        [mul(x3, t3), mul(y3, z3), mul(z3, t3), mul(x3, y3)], axis=0
+    )
+
+
+def main():
+    sys.path.insert(0, ".")
+    from tendermint_tpu.crypto import ed25519 as host
+
+    bp = np.stack(
+        [
+            np.array([int(b) for b in (c % host.P).to_bytes(32, "little")])
+            for c in host.BASEPOINT
+        ]
+    ).astype(np.float32)  # [4, 32]
+    pts = jnp.asarray(np.broadcast_to(bp[:, :, None], (4, 32, B)).copy())
+
+    for n in (32, 256):
+        fn = jax.jit(
+            lambda p, n=n: jnp.sum(
+                jax.lax.fori_loop(0, n, lambda _, v: double(v), p)[0],
+                axis=-2,
+            )
+        )
+        t0 = time.perf_counter()
+        np.asarray(fn(pts))
+        ct = time.perf_counter() - t0
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(fn(pts))
+            best = min(best, time.perf_counter() - t0)
+        print(f"limbmajor double x{n:4d}: compile+1st {ct:6.2f}s run {best*1e3:8.2f} ms")
+
+    q = jax.jit(
+        lambda p: jax.lax.fori_loop(0, 256, lambda _, v: double(v), p)
+    )(pts)
+    q = np.asarray(q)[:, :, 0].astype(np.int64)
+    vals = [sum(int(v) << (8 * i) for i, v in enumerate(row)) for row in q]
+    hq = host.BASEPOINT
+    for _ in range(256):
+        hq = host.point_double(hq)
+    got_x = vals[0] * pow(vals[2], host.P - 2, host.P) % host.P
+    want_x = hq[0] * pow(hq[2], host.P - 2, host.P) % host.P
+    print("correct:", got_x == want_x)
+
+
+if __name__ == "__main__":
+    main()
