@@ -1,0 +1,275 @@
+"""CLI — `python -m tendermint_tpu <command>`.
+
+Reference: cmd/tendermint/main.go:16-48 (cobra command tree): init, start,
+testnet, rollback, reset, gen-validator, gen-node-key, show-node-id,
+show-validator, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+
+from .config import Config
+from .version import (
+    BLOCK_PROTOCOL_VERSION,
+    P2P_PROTOCOL_VERSION,
+    TMCORE_SEM_VER,
+)
+
+
+def _load_config(args) -> Config:
+    cfg = Config.load(args.home)
+    cfg.root_dir = args.home
+    return cfg
+
+
+def cmd_init(args) -> int:
+    from .node import init_files
+
+    cfg = _load_config(args)
+    if args.chain_id:
+        cfg.base.chain_id = args.chain_id
+    init_files(cfg)
+    cfg.save()
+    print(f"initialized node in {args.home}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    from .node import Node
+
+    cfg = _load_config(args)
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+    if args.switch_height:
+        cfg.consensus.switch_height = args.switch_height
+    node = Node(cfg)
+
+    async def run():
+        await node.start()
+        try:
+            await asyncio.Event().wait()  # run until interrupted
+        except asyncio.CancelledError:
+            pass
+        finally:
+            await node.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        print("\nshutting down")
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Generate a local N-validator testnet layout
+    (reference cmd/tendermint/commands/testnet.go)."""
+    import time
+
+    from .p2p.key import NodeKey
+    from .privval.file_pv import FilePV
+    from .types.genesis import GenesisDoc, GenesisValidator
+
+    n = args.v
+    base = args.output
+    os.makedirs(base, exist_ok=True)
+    nodes = []
+    for i in range(n):
+        home = os.path.join(base, f"node{i}")
+        cfg = Config()
+        cfg.root_dir = home
+        cfg.ensure_dirs()
+        nk = NodeKey.load_or_generate(cfg.node_key_file)
+        pv = FilePV.load_or_generate(
+            cfg.priv_validator_key_file, cfg.priv_validator_state_file
+        )
+        nodes.append((home, cfg, nk, pv))
+    doc = GenesisDoc(
+        chain_id=args.chain_id or "testnet-%06x" % (int(time.time()) & 0xFFFFFF),
+        genesis_time_ns=time.time_ns(),
+        validators=[
+            GenesisValidator("ed25519", pv.get_pub_key().data, 10)
+            for _, _, _, pv in nodes
+        ],
+    )
+    doc.validate_and_complete()
+    peers = ",".join(
+        f"{nk.id}@127.0.0.1:{26656 + 10 * i}"
+        for i, (_, _, nk, _) in enumerate(nodes)
+    )
+    for i, (home, cfg, nk, pv) in enumerate(nodes):
+        doc.save_as(cfg.genesis_file)
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{26656 + 10 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{26657 + 10 * i}"
+        cfg.p2p.persistent_peers = peers
+        cfg.save()
+    print(f"wrote {n}-node testnet to {base} (chain {doc.chain_id})")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """Roll back one height of state (reference rollback.go)."""
+    cfg = _load_config(args)
+    from .store.kv import SqliteKV
+    from .state.store import StateStore
+    from .store.block_store import BlockStore
+
+    ss = StateStore(SqliteKV(os.path.join(cfg.db_dir, "state.db")))
+    bs = BlockStore(SqliteKV(os.path.join(cfg.db_dir, "blockstore.db")))
+    state = ss.rollback(bs)
+    if args.hard:
+        bs.prune_blocks_since(state.last_block_height + 1)
+    print(
+        f"rolled back to height {state.last_block_height} "
+        f"(app hash {state.app_hash.hex()})"
+    )
+    return 0
+
+
+def cmd_reset(args) -> int:
+    """unsafe-reset-all: wipe data, keep config (reference reset.go)."""
+    cfg = _load_config(args)
+    data = cfg.db_dir
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+    os.makedirs(data, exist_ok=True)
+    # reset privval state (keep the key)
+    st = cfg.priv_validator_state_file
+    if os.path.exists(st):
+        os.remove(st)
+    print(f"reset {data}")
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from .crypto import ed25519
+
+    k = ed25519.PrivKey.generate()
+    print(
+        json.dumps(
+            {
+                "pub_key": k.public_key().data.hex(),
+                "priv_key_seed": k.seed.hex(),
+                "address": k.public_key().address().hex(),
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from .p2p.key import NodeKey
+
+    nk = NodeKey.generate()
+    print(json.dumps({"id": nk.id}, indent=2))
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from .p2p.key import NodeKey
+
+    cfg = _load_config(args)
+    nk = NodeKey.load_or_generate(cfg.node_key_file)
+    print(nk.id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from .privval.file_pv import FilePV
+
+    cfg = _load_config(args)
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_file, cfg.priv_validator_state_file
+    )
+    pub = pv.get_pub_key()
+    print(
+        json.dumps(
+            {"pub_key": pub.data.hex(), "address": pub.address().hex()}
+        )
+    )
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(
+        f"tendermint-tpu {TMCORE_SEM_VER} "
+        f"(block protocol {BLOCK_PROTOCOL_VERSION}, "
+        f"p2p protocol {P2P_PROTOCOL_VERSION})"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tendermint_tpu",
+        description="TPU-native tendermint (morph fork capabilities)",
+    )
+    p.add_argument(
+        "--home", default=os.environ.get("TMHOME", os.path.expanduser("~/.tendermint_tpu")),
+        help="node home directory",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("init", help="initialize config/genesis/keys")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    sp.add_argument(
+        "--p2p.persistent_peers", dest="persistent_peers", default=""
+    )
+    sp.add_argument(
+        "--consensus.switchHeight",
+        dest="switch_height",
+        type=int,
+        default=0,
+        help="sequencer-mode upgrade height (reference upgrade/upgrade.go)",
+    )
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("testnet", help="generate a local testnet")
+    sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--output", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("rollback", help="roll back one height")
+    sp.add_argument("--hard", action="store_true")
+    sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser("unsafe-reset-all", help="wipe chain data")
+    sp.set_defaults(fn=cmd_reset)
+
+    sp = sub.add_parser("gen-validator", help="generate a validator key")
+    sp.set_defaults(fn=cmd_gen_validator)
+
+    sp = sub.add_parser("gen-node-key", help="generate a node key")
+    sp.set_defaults(fn=cmd_gen_node_key)
+
+    sp = sub.add_parser("show-node-id", help="print this node's p2p id")
+    sp.set_defaults(fn=cmd_show_node_id)
+
+    sp = sub.add_parser("show-validator", help="print this node's validator")
+    sp.set_defaults(fn=cmd_show_validator)
+
+    sp = sub.add_parser("version", help="print version")
+    sp.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,8 +58,10 @@ class BlocksyncReactor(Reactor):
         upgrade_height: int = 0,
         on_upgrade: Optional[Callable] = None,
         logger: Optional[Logger] = None,
+        active: bool = True,
     ):
         super().__init__("blocksync")
+        self.active = active
         self.state = state
         self.executor = executor
         self.block_store = block_store
@@ -85,9 +87,17 @@ class BlocksyncReactor(Reactor):
         ]
 
     async def on_start(self) -> None:
-        self._task = asyncio.get_running_loop().create_task(
-            self._pool_routine()
-        )
+        if self.active:
+            self.start_sync()
+
+    def start_sync(self) -> None:
+        """Launch the sync routine (node assembly defers this until
+        persistent peers are configured; reference fast_sync mode gate)."""
+        if self._task is None:
+            self.active = True
+            self._task = asyncio.get_running_loop().create_task(
+                self._pool_routine()
+            )
 
     async def on_stop(self) -> None:
         if self._task:

@@ -1,0 +1,23 @@
+"""Node configuration tree (reference config/config.go)."""
+
+from .config import (
+    BaseConfig,
+    Config,
+    ConsensusTimeoutsConfig,
+    InstrumentationConfig,
+    P2PConfig,
+    RPCConfig,
+    StateSyncConfig,
+    TxIndexConfig,
+)
+
+__all__ = [
+    "Config",
+    "BaseConfig",
+    "RPCConfig",
+    "P2PConfig",
+    "StateSyncConfig",
+    "ConsensusTimeoutsConfig",
+    "TxIndexConfig",
+    "InstrumentationConfig",
+]

@@ -1,0 +1,329 @@
+"""Config tree: one root struct, per-section defaults/validation, TOML io.
+
+Reference: config/config.go:63-110 (Config root + 8 sections with
+Default*/Test* presets and ValidateBasic), config/toml.go (TOML template
+render). Sections here: base (:162), rpc (:322), p2p (:534), statesync
+(:703), blocksync (:793), consensus (:826), tx_index (:1026),
+instrumentation (:1057), plus the morph-specific [sequencer] knobs
+(upgrade height / sequencer keys — reference wires these via
+--consensus.switchHeight into upgrade.SetUpgradeBlockHeight).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import asdict, dataclass, field, fields
+from typing import Optional
+
+
+@dataclass
+class BaseConfig:
+    moniker: str = "tendermint-tpu-node"
+    chain_id: str = ""  # resolved from the genesis doc
+    db_backend: str = "sqlite"  # sqlite | memory
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""  # remote signer listen addr
+    node_key_file: str = "config/node_key.json"
+    bls_key_file: str = "config/bls_key.json"
+
+    def validate_basic(self) -> None:
+        if self.db_backend not in ("sqlite", "memory"):
+            raise ValueError(f"unknown db_backend {self.db_backend!r}")
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit: float = 10.0
+    pprof_laddr: str = ""
+
+    def validate_basic(self) -> None:
+        if self.max_open_connections < 0:
+            raise ValueError("rpc.max_open_connections cannot be negative")
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""  # comma-separated id@host:port
+    persistent_peers: str = ""
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    pex: bool = True
+    seed_mode: bool = False
+    addr_book_file: str = "config/addrbook.json"
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+
+    def validate_basic(self) -> None:
+        if self.max_num_inbound_peers < 0:
+            raise ValueError("p2p.max_num_inbound_peers cannot be negative")
+        if self.max_num_outbound_peers < 0:
+            raise ValueError("p2p.max_num_outbound_peers cannot be negative")
+
+    def peer_list(self, s: str) -> list[str]:
+        return [p.strip() for p in s.split(",") if p.strip()]
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: str = ""  # >=2 comma-separated light-provider endpoints
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period: float = 168 * 3600.0  # one week, seconds
+    discovery_time: float = 15.0
+    chunk_fetch_timeout: float = 10.0
+
+    def validate_basic(self) -> None:
+        if not self.enable:
+            return
+        if self.trust_height <= 0:
+            raise ValueError("statesync.trust_height is required")
+        if len(self.trust_hash) != 64:
+            raise ValueError("statesync.trust_hash must be 32 hex bytes")
+
+
+@dataclass
+class BlockSyncConfig:
+    enable: bool = True
+
+    def validate_basic(self) -> None:
+        pass
+
+
+@dataclass
+class ConsensusTimeoutsConfig:
+    """Reference ConsensusConfig (config.go:826-877) — wall-clock knobs;
+    maps onto consensus.state_machine.ConsensusConfig."""
+
+    wal_file: str = "data/cs.wal"
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    # morph: the sequencer-mode switch height (upgrade/upgrade.go; flag
+    # --consensus.switchHeight in the reference)
+    switch_height: int = 0
+
+    def validate_basic(self) -> None:
+        for f in (
+            "timeout_propose",
+            "timeout_prevote",
+            "timeout_precommit",
+            "timeout_commit",
+        ):
+            if getattr(self, f) < 0:
+                raise ValueError(f"consensus.{f} cannot be negative")
+
+    def to_state_machine_config(self):
+        from ..consensus.state_machine import ConsensusConfig as SMC
+
+        return SMC(
+            timeout_propose=self.timeout_propose,
+            timeout_propose_delta=self.timeout_propose_delta,
+            timeout_prevote=self.timeout_prevote,
+            timeout_prevote_delta=self.timeout_prevote_delta,
+            timeout_precommit=self.timeout_precommit,
+            timeout_precommit_delta=self.timeout_precommit_delta,
+            timeout_commit=self.timeout_commit,
+            skip_timeout_commit=self.skip_timeout_commit,
+            create_empty_blocks=self.create_empty_blocks,
+        )
+
+
+@dataclass
+class SequencerConfig:
+    """Morph sequencer-mode settings (reference sequencer key mgmt +
+    node.go:1007-1032 createSequencerComponents)."""
+
+    block_interval: float = 3.0
+    sequencer_key_file: str = ""  # secp256k1 key -> this node produces
+    sequencer_addresses: str = ""  # comma-separated 0x… allowed signers
+
+    def validate_basic(self) -> None:
+        pass
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"  # kv | null
+
+    def validate_basic(self) -> None:
+        if self.indexer not in ("kv", "null"):
+            raise ValueError(f"unknown indexer {self.indexer!r}")
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    namespace: str = "tendermint"
+
+    def validate_basic(self) -> None:
+        pass
+
+
+_SECTIONS = {
+    "rpc": RPCConfig,
+    "p2p": P2PConfig,
+    "statesync": StateSyncConfig,
+    "blocksync": BlockSyncConfig,
+    "consensus": ConsensusTimeoutsConfig,
+    "sequencer": SequencerConfig,
+    "tx_index": TxIndexConfig,
+    "instrumentation": InstrumentationConfig,
+}
+
+
+@dataclass
+class Config:
+    root_dir: str = "."
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    consensus: ConsensusTimeoutsConfig = field(
+        default_factory=ConsensusTimeoutsConfig
+    )
+    sequencer: SequencerConfig = field(default_factory=SequencerConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig
+    )
+
+    # --- presets ------------------------------------------------------------
+
+    @classmethod
+    def default(cls) -> "Config":
+        return cls()
+
+    @classmethod
+    def test_config(cls) -> "Config":
+        c = cls()
+        c.base.db_backend = "memory"
+        c.consensus.timeout_propose = 0.4
+        c.consensus.timeout_propose_delta = 0.1
+        c.consensus.timeout_prevote = 0.2
+        c.consensus.timeout_prevote_delta = 0.1
+        c.consensus.timeout_precommit = 0.2
+        c.consensus.timeout_precommit_delta = 0.1
+        c.consensus.timeout_commit = 0.05
+        c.consensus.skip_timeout_commit = True
+        return c
+
+    # --- paths --------------------------------------------------------------
+
+    def path(self, rel: str) -> str:
+        return rel if os.path.isabs(rel) else os.path.join(self.root_dir, rel)
+
+    @property
+    def genesis_file(self) -> str:
+        return self.path(self.base.genesis_file)
+
+    @property
+    def node_key_file(self) -> str:
+        return self.path(self.base.node_key_file)
+
+    @property
+    def priv_validator_key_file(self) -> str:
+        return self.path(self.base.priv_validator_key_file)
+
+    @property
+    def priv_validator_state_file(self) -> str:
+        return self.path(self.base.priv_validator_state_file)
+
+    @property
+    def wal_file(self) -> str:
+        return self.path(self.consensus.wal_file)
+
+    @property
+    def addr_book_file(self) -> str:
+        return self.path(self.p2p.addr_book_file)
+
+    @property
+    def db_dir(self) -> str:
+        return self.path("data")
+
+    def ensure_dirs(self) -> None:
+        for d in ("config", "data"):
+            os.makedirs(os.path.join(self.root_dir, d), exist_ok=True)
+
+    # --- validation ----------------------------------------------------------
+
+    def validate_basic(self) -> None:
+        self.base.validate_basic()
+        for name in _SECTIONS:
+            getattr(self, name if name != "tx_index" else "tx_index").validate_basic()
+
+    # --- TOML ----------------------------------------------------------------
+
+    def to_toml(self) -> str:
+        """Render the config file (reference config/toml.go template)."""
+
+        def render_value(v):
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if isinstance(v, (int, float)):
+                return repr(v)
+            return '"%s"' % str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+        out = [
+            "# tendermint-tpu node configuration",
+            "# (shape mirrors the reference config/config.go sections)",
+            "",
+        ]
+        for f in fields(BaseConfig):
+            out.append(f"{f.name} = {render_value(getattr(self.base, f.name))}")
+        for section, typ in _SECTIONS.items():
+            out.append("")
+            out.append(f"[{section}]")
+            obj = getattr(self, section)
+            for f in fields(typ):
+                out.append(
+                    f"{f.name} = {render_value(getattr(obj, f.name))}"
+                )
+        return "\n".join(out) + "\n"
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path("config/config.toml")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_toml())
+        return path
+
+    @classmethod
+    def load(cls, root_dir: str) -> "Config":
+        """Load <root>/config/config.toml (defaults for missing keys)."""
+        cfg = cls()
+        cfg.root_dir = root_dir
+        path = os.path.join(root_dir, "config", "config.toml")
+        if not os.path.exists(path):
+            return cfg
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        for f_ in fields(BaseConfig):
+            if f_.name in data:
+                setattr(cfg.base, f_.name, data[f_.name])
+        for section, typ in _SECTIONS.items():
+            if section not in data:
+                continue
+            obj = getattr(cfg, section)
+            for f_ in fields(typ):
+                if f_.name in data[section]:
+                    setattr(obj, f_.name, data[section][f_.name])
+        cfg.validate_basic()
+        return cfg

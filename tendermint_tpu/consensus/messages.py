@@ -160,6 +160,10 @@ class BlockPartMessage:
 @dataclass
 class VoteMessage:
     vote: Vote
+    # in-process only (never wire-encoded): the reactor's micro-batcher
+    # already verified this vote's signature on the device, so the state
+    # machine can insert without re-verifying (SURVEY.md §7.3 hard part 3)
+    pre_verified: bool = False
 
     TAG = 6
 

@@ -117,9 +117,21 @@ class PeerRoundState:
 
 
 class ConsensusReactor(Reactor):
-    def __init__(self, cs: ConsensusState, logger: Optional[Logger] = None):
+    def __init__(
+        self,
+        cs: ConsensusState,
+        vote_batcher=None,
+        logger: Optional[Logger] = None,
+    ):
         super().__init__("consensus")
         self.cs = cs
+        # device micro-batcher for incoming vote signatures; None falls
+        # back to the state machine's serial verify
+        if vote_batcher is None:
+            from .vote_batcher import VoteBatcher
+
+            vote_batcher = VoteBatcher(verifier=cs.verifier)
+        self.vote_batcher = vote_batcher
         self.logger = logger or nop_logger()
         self._peer_states: dict[str, PeerRoundState] = {}
         self._peer_tasks: dict[str, list[asyncio.Task]] = {}
@@ -209,6 +221,10 @@ class ConsensusReactor(Reactor):
             t.cancel()
         self._peer_states.pop(peer.id, None)
 
+    async def on_stop(self) -> None:
+        if self.vote_batcher is not None:
+            self.vote_batcher.stop()
+
     # --- receive ----------------------------------------------------------
 
     async def receive(self, channel_id: int, peer: Peer, msg_bytes: bytes) -> None:
@@ -287,7 +303,35 @@ class ConsensusReactor(Reactor):
                     msg.vote.validator_index,
                     size,
                 )
-                await cs.add_vote(msg.vote, peer.id)
+                # pre-verify through the micro-batcher: votes arriving
+                # from all peers while the device is busy form one batch
+                # (SURVEY.md §7.3 hard part 3); the await also applies
+                # per-peer backpressure. The state machine skips its
+                # serial check for pre-verified votes.
+                vote = msg.vote
+                pub = cs.pubkey_for_vote(vote)
+                pre_verified = False
+                if pub is not None and self.vote_batcher is not None:
+                    pre_verified = await self.vote_batcher.submit(
+                        pub.data,
+                        vote.sign_bytes(cs.state.chain_id),
+                        vote.signature,
+                        key_type=getattr(pub, "type_name", "ed25519"),
+                    )
+                    if not pre_verified:
+                        # the device already judged this signature invalid
+                        # — don't hand it to the state machine for a
+                        # second, serial verification on the event loop
+                        self.logger.info(
+                            "dropping invalid vote", peer=peer.id
+                        )
+                        await self.switch.stop_peer_for_error(
+                            peer, "invalid vote signature"
+                        )
+                        return
+                await cs.peer_msg_queue.put(
+                    (VoteMessage(vote, pre_verified=pre_verified), peer.id)
+                )
         elif channel_id == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, VoteSetBitsMessage) and msg.height == cs.rs.height:
                 vs = (

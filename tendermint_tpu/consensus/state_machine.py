@@ -43,6 +43,7 @@ from ..types.part_set import Part, PartSet
 from ..types.proposal import Proposal
 from ..types.vote import Vote, VoteType
 from ..types.vote_set import ConflictingVoteError, VoteSet
+from .batch import BatchCache, get_batch_start
 from .height_vote_set import HeightVoteSet
 from .messages import BlockPartMessage, ProposalMessage, VoteMessage
 from .ticker import TimeoutInfo, TimeoutTicker
@@ -147,6 +148,7 @@ class ConsensusState:
         upgrade_height: int = 0,
         on_upgrade: Optional[Callable] = None,
         evidence_pool=None,
+        metrics=None,
         logger: Optional[Logger] = None,
         now_ns: Callable[[], int] = time.time_ns,
     ):
@@ -163,8 +165,10 @@ class ConsensusState:
         self.upgrade_height = upgrade_height
         self.on_upgrade = on_upgrade
         self.evpool = evidence_pool
+        self.metrics = metrics  # libs.metrics.ConsensusMetrics or None
         self.logger = logger or nop_logger()
         self.now_ns = now_ns
+        self._last_commit_walltime = 0.0
 
         self.event_switch = EventSwitch()
 
@@ -179,6 +183,8 @@ class ConsensusState:
         self._stopped = asyncio.Event()
         self._running = False
         self._decided_batch: Optional[tuple[bytes, bytes]] = None  # hash, header
+        # L2 batch state across heights/restarts (reference consensus/batch.go)
+        self.batch_cache = BatchCache()
         # height -> asyncio.Event fired after finalize (test hook)
         self._height_waiters: dict[int, asyncio.Event] = {}
         # called with each self-produced message (proposal/part/vote); the
@@ -186,6 +192,10 @@ class ConsensusState:
         # harness's stand-in for gossip (reconstructing the deleted
         # consensus/common_test.go net, SURVEY.md §4.1)
         self.broadcast_hook: Optional[Callable] = None
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
 
     # --- lifecycle --------------------------------------------------------
 
@@ -310,7 +320,9 @@ class ConsensusState:
             if added:
                 await self._handle_complete_proposal(msg.height)
         elif isinstance(msg, VoteMessage):
-            await self._try_add_vote(msg.vote, peer_id)
+            await self._try_add_vote(
+                msg.vote, peer_id, pre_verified=msg.pre_verified
+            )
         else:
             self.logger.error("unknown msg type", msg=type(msg).__name__)
 
@@ -474,16 +486,40 @@ class ConsensusState:
             block_data,
             block_time,
         )
-        # decideBatchPoint (reference :1318-1362): ask the L2 node whether
-        # this block seals the batch; if so the header carries the batch
-        # hash and the data carries the sealed header.
+        # decideBatchPoint (reference :1318-1362): seal when the L2 says
+        # size is exceeded OR the on-chain Batch params' blocks_interval /
+        # timeout elapsed since the batch start (which survives restarts
+        # via the block-store walk in get_batch_start, batch.go:67-99).
         self._decided_batch = None
-        if self.l2.calculate_batch_size_with_proposal_block(
+        start_h, start_t = get_batch_start(
+            self.batch_cache,
+            block.header.height,
+            self.state.initial_height,
+            self.state.last_block_time_ns,
+            self.block_store,
+        )
+        bp = self.state.consensus_params.batch
+        size_exceeded = self.l2.calculate_batch_size_with_proposal_block(
             block.encode(), False
-        ):
+        )
+        seal = block.header.height != 1 and (
+            size_exceeded
+            or (
+                bp.blocks_interval > 0
+                and block.header.height - start_h >= bp.blocks_interval
+            )
+            or (
+                bp.timeout_ns > 0
+                and block.header.time_ns - start_t >= bp.timeout_ns
+            )
+        )
+        if seal:
             batch_hash, batch_header = self.l2.seal_batch()
             block.set_batch_point(batch_hash, batch_header)
             self._decided_batch = (batch_hash, batch_header)
+            self.batch_cache.store_batch_data(
+                block.hash(), batch_hash, batch_header
+            )
         parts = block.make_part_set()
         return block, parts
 
@@ -776,6 +812,9 @@ class ConsensusState:
         )
         fail.fail_point()
 
+        # batch cache rollover (reference state.go:1902-1910)
+        self.batch_cache.on_block_committed(block)
+
         # upgrade switch (reference state.go:1921-1938 + upgrade/upgrade.go)
         if self.upgrade_height and height >= self.upgrade_height:
             self.logger.info("upgrade height reached; stopping BFT", height=height)
@@ -803,6 +842,16 @@ class ConsensusState:
     def _update_to_state(self, state: State) -> None:
         """updateToState (reference :622): reset RoundState for the next
         height."""
+        if self.metrics is not None:
+            self.metrics.height.set(state.last_block_height)
+            if state.validators is not None:
+                self.metrics.validators.set(state.validators.size())
+            now = time.monotonic()
+            if self._last_commit_walltime and state.last_block_height:
+                self.metrics.block_interval.observe(
+                    now - self._last_commit_walltime
+                )
+            self._last_commit_walltime = now
         rs = self.rs
         last_precommits = None
         if rs.commit_round > -1 and rs.votes is not None:
@@ -846,9 +895,11 @@ class ConsensusState:
 
     # --- votes ------------------------------------------------------------
 
-    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+    async def _try_add_vote(
+        self, vote: Vote, peer_id: str, pre_verified: bool = False
+    ) -> bool:
         try:
-            return await self._add_vote(vote, peer_id)
+            return await self._add_vote(vote, peer_id, pre_verified)
         except ConflictingVoteError as e:
             # equivocation: report to the pool, which resolves the
             # validator against the HISTORICAL set at the vote's height and
@@ -868,8 +919,11 @@ class ConsensusState:
             self.logger.info("bad vote", err=repr(e))
             return False
 
-    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
-        """addVote (reference :2274-2519)."""
+    async def _add_vote(
+        self, vote: Vote, peer_id: str, pre_verified: bool = False
+    ) -> bool:
+        """addVote (reference :2274-2519). `pre_verified` votes already
+        passed the reactor's device micro-batcher; skip the serial check."""
         rs = self.rs
         # precommit from the previous height (straggler for LastCommit)
         if (
@@ -879,13 +933,17 @@ class ConsensusState:
             and rs.last_commit is not None
         ):
             added = rs.last_commit.add_vote(
-                vote, verified=self._verify_vote(vote, self.state.last_validators)
+                vote,
+                verified=pre_verified
+                or self._verify_vote(vote, self.state.last_validators),
             )
             return added
         if vote.height != rs.height:
             return False
 
-        if not self._verify_vote(vote, self.state.validators):
+        if not pre_verified and not self._verify_vote(
+            vote, self.state.validators
+        ):
             raise ValueError("invalid vote signature")
 
         # morph: BLS dual-signature on batch-point precommits
@@ -925,12 +983,34 @@ class ConsensusState:
         return added
 
     def _batch_hash_for_block(self, block_hash: bytes) -> bytes:
-        """The batch hash if block_hash is a known batch-point proposal."""
+        """The batch hash if block_hash is a known batch-point proposal
+        (the per-proposal cache first — reference
+        decideBatchPointWithProposedBlock :1365-1377)."""
+        bd = self.batch_cache.batch_data(block_hash)
+        if bd is not None and bd.batch_hash:
+            return bd.batch_hash
         rs = self.rs
         for blk in (rs.proposal_block, rs.locked_block, rs.valid_block):
             if blk is not None and blk.hash() == block_hash:
                 return blk.header.batch_hash
         return b""
+
+    def pubkey_for_vote(self, vote: Vote):
+        """Resolve the signer pubkey for a vote (reactor micro-batcher
+        pre-verification). None if the index/address don't match the
+        validator set for the vote's height."""
+        if vote.height + 1 == self.rs.height:
+            vals = self.state.last_validators
+        elif vote.height == self.rs.height:
+            vals = self.state.validators
+        else:
+            return None
+        if vals is None:
+            return None
+        val = vals.get_by_index(vote.validator_index)
+        if val is None or val.address != vote.validator_address:
+            return None
+        return val.pub_key
 
     def _verify_vote(self, vote: Vote, vals) -> bool:
         """Signature check through the batch verifier (host fast path for

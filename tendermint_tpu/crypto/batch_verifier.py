@@ -14,6 +14,13 @@ Responsibilities:
   range check;
 - shape discipline: batches are padded up to a small set of bucket sizes so
   XLA compiles a handful of programs, not one per batch size;
+- the validator-table cache: consensus re-verifies the SAME pubkeys every
+  height (2N sigs/height from one validator set — SURVEY.md §3.3), so each
+  pubkey's decompressed negated window table is built once, stored in a
+  device-resident array, and gathered by row index at verify time — the
+  steady-state vote path skips decompression and table construction
+  entirely;
+- mixed key types: non-ed25519 rows (secp256k1) partition to host verify;
 - optional mesh sharding: with a `jax.sharding.Mesh`, the batch axis is
   sharded across devices (`NamedSharding`) so one commit's votes spread over
   ICI — the "data-parallel batch sharding" strategy of SURVEY.md §2.3.
@@ -21,6 +28,7 @@ Responsibilities:
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -35,6 +43,10 @@ from .ed25519 import L, challenge
 # Bucket sizes: small buckets for consensus latency (votes trickle in),
 # large for blocksync/light-client bulk replay.
 BUCKETS = (8, 32, 128, 512, 2048, 8192)
+
+# default capacity of the device-resident validator table cache
+# ([cap, 16, 4, 32] int32 = cap * 8 KiB)
+TABLE_CACHE_CAPACITY = 4096
 
 
 def _bucket(n: int, multiple_of: int = 1) -> int:
@@ -56,6 +68,13 @@ class SigItem:
     key_type: str = "ed25519"
 
 
+def _verify_cached(tables, tvalid, idx, rb, sb, kb, s_ok):
+    """Gather each row's table from the cache and verify (one jit)."""
+    t = jnp.take(tables, idx, axis=0)
+    tv = jnp.take(tvalid, idx, axis=0) & (idx >= 0)
+    return ed25519_batch.verify_prehashed_table(t, tv, rb, sb, kb, s_ok)
+
+
 class BatchVerifier:
     """Batched ed25519 verifier over one device or a device mesh.
 
@@ -65,7 +84,12 @@ class BatchVerifier:
     the reduction rides ICI).
     """
 
-    def __init__(self, mesh: Mesh | None = None, min_device_batch: int = 8):
+    def __init__(
+        self,
+        mesh: Mesh | None = None,
+        min_device_batch: int = 8,
+        table_cache_capacity: int = TABLE_CACHE_CAPACITY,
+    ):
         """min_device_batch: below this size the host CPU verifies serially
         — a device round-trip costs more than a handful of host verifies
         (the adaptive micro-batching tradeoff, SURVEY.md §7.3 hard part 3).
@@ -74,6 +98,8 @@ class BatchVerifier:
         self._min_device_batch = min_device_batch
         if mesh is None:
             self._fn = jax.jit(ed25519_batch.verify_prehashed)
+            self._cached_fn = jax.jit(_verify_cached)
+            self._build_fn = jax.jit(ed25519_batch.neg_pubkey_table)
             self._nshards = 1
         else:
             sh = NamedSharding(mesh, P("batch"))
@@ -83,7 +109,79 @@ class BatchVerifier:
                 in_shardings=(sh, sh, sh, sh, sh),
                 out_shardings=rep,
             )
+            # table cache stays replicated; the batch axis shards
+            self._cached_fn = jax.jit(
+                _verify_cached,
+                in_shardings=(rep, rep, sh, sh, sh, sh, sh),
+                out_shardings=rep,
+            )
+            self._build_fn = jax.jit(
+                ed25519_batch.neg_pubkey_table,
+                in_shardings=(sh,),
+                out_shardings=(rep, rep),
+            )
             self._nshards = mesh.devices.size
+        # validator table cache (pubkey bytes -> row in the device array).
+        # Guarded by a lock: the vote micro-batcher calls verify() from an
+        # executor thread while the event-loop thread verifies serially.
+        self._cache_lock = threading.Lock()
+        self._cache_capacity = table_cache_capacity
+        self._cache_idx: dict[bytes, int] = {}
+        self._tables = jnp.zeros(
+            (max(1, table_cache_capacity), 16, 4, 32), dtype=jnp.int32
+        )
+        self._tables_valid = jnp.zeros(
+            max(1, table_cache_capacity), dtype=bool
+        )
+
+    # --- table cache -------------------------------------------------------
+
+    def warm(self, pubkeys: list[bytes]) -> None:
+        """Pre-build tables for a validator set (e.g. at height change)."""
+        self._ensure_tables(
+            [pk for pk in pubkeys if len(pk) == 32]
+        )
+
+    def _ensure_tables(self, pubkeys: list[bytes]) -> bool:
+        """Build + install tables for unseen pubkeys (thread-safe). The
+        cache resets when full (validator rotation must not silently
+        degrade the hot path forever); the next batches repopulate it."""
+        with self._cache_lock:
+            new = []
+            seen = set()
+            for pk in pubkeys:
+                if pk not in self._cache_idx and pk not in seen:
+                    seen.add(pk)
+                    new.append(pk)
+            if not new:
+                return True
+            if len(self._cache_idx) + len(new) > self._cache_capacity:
+                # reset: every unique pubkey in THIS batch must be rebuilt
+                # (previously-cached ones lose their rows in the wipe)
+                uniq = list(dict.fromkeys(pubkeys))
+                if len(uniq) > self._cache_capacity:
+                    return False  # batch alone exceeds capacity
+                self._cache_idx.clear()
+                self._tables_valid = jnp.zeros_like(self._tables_valid)
+                new = uniq
+            b = _bucket(len(new), multiple_of=self._nshards)
+            arr = np.zeros((b, 32), dtype=np.uint8)
+            for i, pk in enumerate(new):
+                arr[i] = np.frombuffer(pk, dtype=np.uint8)
+            tables, valid = self._build_fn(jnp.asarray(arr))
+            rows = []
+            for pk in new:
+                row = len(self._cache_idx)
+                self._cache_idx[pk] = row
+                rows.append(row)
+            rows_j = jnp.asarray(np.asarray(rows, dtype=np.int32))
+            self._tables = self._tables.at[rows_j].set(tables[: len(new)])
+            self._tables_valid = self._tables_valid.at[rows_j].set(
+                valid[: len(new)]
+            )
+            return True
+
+    # --- verification ------------------------------------------------------
 
     def verify(self, items: list[SigItem]) -> np.ndarray:
         """Returns a bool accept bitmap aligned with `items`.
@@ -117,22 +215,45 @@ class BatchVerifier:
                 dtype=bool,
             )
         b = _bucket(n, multiple_of=self._nshards)
-        pub = np.zeros((b, 32), dtype=np.uint8)
         rb = np.zeros((b, 32), dtype=np.uint8)
         sb = np.zeros((b, 32), dtype=np.uint8)
         kb = np.zeros((b, 32), dtype=np.uint8)
         s_ok = np.zeros(b, dtype=bool)
+        well_formed = []
         for i, it in enumerate(items):
             if len(it.pubkey) != 32 or len(it.sig) != 64:
                 continue  # leave row zeroed; s_ok stays False -> reject
             r, s = it.sig[:32], it.sig[32:]
-            s_int = int.from_bytes(s, "little")
             k = challenge(r, it.pubkey, it.msg)
-            pub[i] = np.frombuffer(it.pubkey, dtype=np.uint8)
             rb[i] = np.frombuffer(r, dtype=np.uint8)
             sb[i] = np.frombuffer(s, dtype=np.uint8)
             kb[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
-            s_ok[i] = s_int < L
+            s_ok[i] = int.from_bytes(s, "little") < L
+            well_formed.append(i)
+
+        if self._ensure_tables(
+            [items[i].pubkey for i in well_formed]
+        ):
+            with self._cache_lock:
+                tables, tvalid = self._tables, self._tables_valid
+                idx = np.full(b, -1, dtype=np.int32)
+                for i in well_formed:
+                    idx[i] = self._cache_idx[items[i].pubkey]
+            out = self._cached_fn(
+                tables,
+                tvalid,
+                jnp.asarray(idx),
+                rb,
+                sb,
+                kb,
+                jnp.asarray(s_ok),
+            )
+            return np.asarray(out)[:n]
+
+        # cache full: generic path (decompress in-batch)
+        pub = np.zeros((b, 32), dtype=np.uint8)
+        for i in well_formed:
+            pub[i] = np.frombuffer(items[i].pubkey, dtype=np.uint8)
         out = self._fn(pub, rb, sb, kb, jnp.asarray(s_ok))
         return np.asarray(out)[:n]
 

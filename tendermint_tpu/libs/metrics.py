@@ -1,0 +1,239 @@
+"""Prometheus-style metrics: counters/gauges/histograms + text exposition.
+
+Reference: the metricsgen-generated structs (consensus/metrics.go:23,
+p2p/metrics.go, state/metrics.go, proxy/metrics.go:16) served at
+InstrumentationConfig.PrometheusListenAddr (node/node.go:1062-1065).
+No external client library: the registry renders the text exposition
+format (v0.0.4) itself and a tiny asyncio HTTP server exposes /metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Optional
+
+from .service import Service
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = labels
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(k, "") for k in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        key = tuple(labels.get(k, "") for k in self.label_names)
+        return self._values.get(key, 0.0)
+
+    def render(self) -> list[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} counter",
+        ]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
+        if not self._values:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels) -> None:
+        key = tuple(labels.get(k, "") for k in self.label_names)
+        with self._lock:
+            self._values[key] = value
+
+    def render(self) -> list[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+        ]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
+        if not self._values:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (
+        0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, float("inf")
+    )
+
+    def __init__(self, name: str, help_: str, buckets=None):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._total += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+
+    def time(self):
+        """Context manager observing elapsed seconds."""
+        h = self
+
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *a):
+                h.observe(time.perf_counter() - self.t0)
+
+        return _T()
+
+    def render(self) -> list[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        for b, c in zip(self.buckets, self._counts):
+            le = "+Inf" if b == float("inf") else repr(b)
+            out.append(f'{self.name}_bucket{{le="{le}"}} {c}')
+        out.append(f"{self.name}_sum {self._sum}")
+        out.append(f"{self.name}_count {self._total}")
+        return out
+
+
+def _fmt_labels(names, values) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{%s}" % pairs
+
+
+class Registry:
+    def __init__(self, namespace: str = "tendermint"):
+        self.namespace = namespace
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_="", labels=()) -> Counter:
+        return self._get(name, lambda n: Counter(n, help_, labels))
+
+    def gauge(self, name, help_="", labels=()) -> Gauge:
+        return self._get(name, lambda n: Gauge(n, help_, labels))
+
+    def histogram(self, name, help_="", buckets=None) -> Histogram:
+        return self._get(name, lambda n: Histogram(n, help_, buckets))
+
+    def _get(self, name, factory):
+        full = f"{self.namespace}_{name}"
+        with self._lock:
+            if full not in self._metrics:
+                self._metrics[full] = factory(full)
+            return self._metrics[full]
+
+    def render(self) -> str:
+        lines = []
+        for m in self._metrics.values():
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+_registry: Optional[Registry] = None
+
+
+def default_registry() -> Registry:
+    global _registry
+    if _registry is None:
+        _registry = Registry()
+    return _registry
+
+
+# --- the standard node metric set (consensus/metrics.go:23 et al.) --------
+
+
+class ConsensusMetrics:
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.height = reg.gauge("consensus_height", "Current block height")
+        self.rounds = reg.counter(
+            "consensus_rounds", "Rounds entered beyond round 0"
+        )
+        self.validators = reg.gauge(
+            "consensus_validators", "Validator set size"
+        )
+        self.block_interval = reg.histogram(
+            "consensus_block_interval_seconds",
+            "Time between this and the last block",
+        )
+        self.total_txs = reg.counter("consensus_total_txs", "Committed txs")
+        self.votes_verified = reg.counter(
+            "consensus_votes_verified", "Vote signatures verified", ("path",)
+        )
+        self.verify_batch_size = reg.histogram(
+            "consensus_verify_batch_size",
+            "Signatures per device verify batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 512, 2048, float("inf")),
+        )
+
+
+class P2PMetrics:
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.peers = reg.gauge("p2p_peers", "Connected peers")
+        self.message_receive_bytes = reg.counter(
+            "p2p_message_receive_bytes_total", "Bytes received", ("chID",)
+        )
+        self.message_send_bytes = reg.counter(
+            "p2p_message_send_bytes_total", "Bytes sent", ("chID",)
+        )
+
+
+class MetricsServer(Service):
+    """Serves GET /metrics in the text exposition format."""
+
+    def __init__(self, registry: Registry, host: str, port: int):
+        super().__init__("metrics")
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def on_start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def on_stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader, writer):
+        try:
+            await reader.readline()  # request line; drain headers
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            body = self.registry.render().encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/plain; version=0.0.4\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"Connection: close\r\n\r\n" + body
+            )
+            await writer.drain()
+        finally:
+            writer.close()

@@ -1,0 +1,5 @@
+"""Node assembly (reference node/node.go)."""
+
+from .node import Node, init_files
+
+__all__ = ["Node", "init_files"]

@@ -1,0 +1,438 @@
+"""Node — dependency-injection assembly of every service.
+
+Reference: node/node.go:775-1038 (NewNode wiring order: DBs → state →
+proxyApp → eventBus+indexer → privval → handshake → evidence → blockExec →
+blocksync → consensus → statesync → transport/switch/addrbook/PEX →
+sequencer components), OnStart :1041-1109 (RPC → prometheus → transport →
+switch → dial peers → statesync), OnStop :1112, sequencer switch :1612.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+from ..abci.client import LocalClient
+from ..blocksync.reactor import BlocksyncReactor
+from ..config import Config
+from ..consensus.reactor import ConsensusReactor
+from ..consensus.state_machine import ConsensusState
+from ..consensus.wal import WAL
+from ..crypto import secp256k1
+from ..evidence import EvidencePool, EvidenceReactor
+from ..libs.log import Logger, default_logger
+from ..libs.service import Service
+from ..p2p.key import NodeKey
+from ..p2p.node_info import NodeInfo
+from ..p2p.pex import AddrBook, PEXReactor
+from ..p2p.switch import Switch
+from ..p2p.transport import MultiplexTransport, NetAddress
+from ..privval.file_pv import FilePV
+from ..proxy.multi_app_conn import AppConns, ClientCreator
+from ..sequencer import (
+    BlockBroadcastReactor,
+    LocalSigner,
+    StateV2,
+    StaticSequencerVerifier,
+)
+from ..state.execution import BlockExecutor
+from ..state.state import State
+from ..state.store import StateStore
+from ..statesync import StateSyncReactor
+from ..store.block_store import BlockStore
+from ..store.kv import MemKV, SqliteKV
+from ..types.event_bus import EventBus
+from ..types.genesis import GenesisDoc
+
+
+def init_files(config: Config, logger: Optional[Logger] = None) -> GenesisDoc:
+    """`tendermint init` (reference cmd/tendermint/commands/init.go):
+    generate node key, privval files, and a single-validator genesis."""
+    logger = logger or default_logger()
+    config.ensure_dirs()
+    nk = NodeKey.load_or_generate(config.node_key_file)
+    pv = FilePV.load_or_generate(
+        config.priv_validator_key_file, config.priv_validator_state_file
+    )
+    gen_path = config.genesis_file
+    if os.path.exists(gen_path):
+        doc = GenesisDoc.from_file(gen_path)
+        logger.info("found existing genesis", path=gen_path)
+    else:
+        from ..types.genesis import GenesisValidator
+        import time
+
+        doc = GenesisDoc(
+            chain_id=config.base.chain_id or "test-chain-%06x" % (
+                int.from_bytes(os.urandom(3), "big")
+            ),
+            genesis_time_ns=time.time_ns(),
+            validators=[
+                GenesisValidator(
+                    "ed25519", pv.get_pub_key().data, 10
+                )
+            ],
+        )
+        doc.validate_and_complete()
+        doc.save_as(gen_path)
+        logger.info("generated genesis", path=gen_path, chain_id=doc.chain_id)
+    logger.info("node id", id=nk.id)
+    return doc
+
+
+class Node(Service):
+    """One running node over a local ABCI app + (mock or real) L2 node."""
+
+    def __init__(
+        self,
+        config: Config,
+        app=None,
+        l2_node=None,
+        genesis: Optional[GenesisDoc] = None,
+        logger: Optional[Logger] = None,
+    ):
+        logger = logger or default_logger()
+        super().__init__("node", logger)
+        self.config = config
+        config.ensure_dirs()
+
+        # --- identity / keys (node.go:100-129) ---
+        self.node_key = NodeKey.load_or_generate(config.node_key_file)
+        self.priv_validator = FilePV.load_or_generate(
+            config.priv_validator_key_file, config.priv_validator_state_file
+        )
+
+        # --- genesis + state (node.go:797-805) ---
+        self.genesis = genesis or GenesisDoc.from_file(config.genesis_file)
+
+        def make_kv(name: str):
+            if config.base.db_backend == "memory":
+                return MemKV()
+            return SqliteKV(os.path.join(config.db_dir, f"{name}.db"))
+
+        self.state_store = StateStore(make_kv("state"))
+        self.block_store = BlockStore(make_kv("blockstore"))
+        state = self.state_store.load()
+        if state is None:
+            state = State.from_genesis(self.genesis)
+            self.state_store.bootstrap(state)
+
+        # --- app + L2 (PROCESS BOUNDARY in production; in-proc here) ---
+        if app is None:
+            from ..abci.kvstore import KVStoreApplication
+
+            app = KVStoreApplication()
+        if l2_node is None:
+            from ..l2node.mock import MockL2Node
+
+            l2_node = MockL2Node()
+        self.app = app
+        self.l2_node = l2_node
+        self.app_client = LocalClient(app)
+        self.proxy_app = AppConns(ClientCreator(lambda: LocalClient(app)))
+
+        # --- event bus + indexer (node.go:287-347) ---
+        self.event_bus = EventBus()
+        self.indexer_service = None
+        if config.tx_index.indexer == "kv":
+            try:
+                from ..state.txindex import IndexerService, KVIndexer
+
+                self.indexer = KVIndexer(make_kv("txindex"))
+                self.indexer_service = IndexerService(
+                    self.indexer, self.event_bus
+                )
+            except ImportError:
+                self.indexer = None
+
+        # --- evidence (node.go:403) ---
+        self.evidence_pool = EvidencePool(
+            make_kv("evidence"), self.state_store, self.block_store
+        )
+
+        # --- executor (node.go:883) ---
+        self.block_executor = BlockExecutor(
+            self.state_store,
+            self.block_store,
+            self.app_client,
+            l2_node,
+            event_bus=self.event_bus,
+            evidence_pool=self.evidence_pool,
+            logger=self.logger,
+        )
+
+        # --- sequencer components (node.go:1007-1032) ---
+        seq_signer = None
+        if config.sequencer.sequencer_key_file:
+            with open(config.path(config.sequencer.sequencer_key_file)) as f:
+                key = secp256k1.PrivKey.from_bytes(
+                    bytes.fromhex(f.read().strip())
+                )
+            seq_signer = LocalSigner(key)
+        allowed = [
+            bytes.fromhex(a.strip().removeprefix("0x"))
+            for a in config.sequencer.sequencer_addresses.split(",")
+            if a.strip()
+        ]
+        if seq_signer and not allowed:
+            allowed = [seq_signer.address()]
+        self.sequencer_verifier = StaticSequencerVerifier(allowed)
+        self.state_v2 = StateV2(
+            l2_node,
+            block_interval=config.sequencer.block_interval,
+            signer=seq_signer,
+            verifier=self.sequencer_verifier,
+            logger=self.logger,
+        )
+        self.sequencer_reactor = BlockBroadcastReactor(
+            self.state_v2, self.sequencer_verifier, wait_sync=True,
+            logger=self.logger,
+        )
+
+        # --- consensus (node.go:460-501) ---
+        from ..libs.metrics import ConsensusMetrics, default_registry
+
+        self.metrics_registry = default_registry()
+        wal = WAL(config.wal_file)
+        self.consensus = ConsensusState(
+            config.consensus.to_state_machine_config(),
+            state,
+            self.block_executor,
+            self.block_store,
+            l2_node,
+            priv_validator=self.priv_validator,
+            event_bus=self.event_bus,
+            wal=wal,
+            upgrade_height=config.consensus.switch_height,
+            on_upgrade=self._switch_to_sequencer_mode,
+            evidence_pool=self.evidence_pool,
+            metrics=ConsensusMetrics(self.metrics_registry),
+            logger=self.logger,
+        )
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus, logger=self.logger
+        )
+
+        # --- blocksync (node.go:435-458) ---
+        self.blocksync_reactor = BlocksyncReactor(
+            state,
+            self.block_executor,
+            self.block_store,
+            l2_node,
+            on_caught_up=self._switch_to_consensus,
+            upgrade_height=config.consensus.switch_height,
+            on_upgrade=self._switch_to_sequencer_mode,
+            logger=self.logger,
+            active=False,  # started explicitly when peers are configured
+        )
+
+        # --- statesync reactor (node.go:916) ---
+        self.statesync_reactor = StateSyncReactor(
+            app, syncer=None, logger=self.logger
+        )
+
+        # --- p2p (node.go:929-967) ---
+        transport = None
+        sw = None
+
+        def node_info() -> NodeInfo:
+            return NodeInfo(
+                node_id=self.node_key.id,
+                listen_addr=self._listen_addr(),
+                network=self.genesis.chain_id,
+                channels=sw.channels() if sw else b"",
+                moniker=config.base.moniker,
+            )
+
+        transport = MultiplexTransport(self.node_key, node_info)
+        sw = Switch(transport, logger=self.logger)
+        self.transport = transport
+        self.switch = sw
+        sw.add_reactor("consensus", self.consensus_reactor)
+        sw.add_reactor("blocksync", self.blocksync_reactor)
+        sw.add_reactor("evidence", EvidenceReactor(self.evidence_pool, self.logger))
+        sw.add_reactor("statesync", self.statesync_reactor)
+        sw.add_reactor("sequencer", self.sequencer_reactor)
+        if config.p2p.pex:
+            self.addr_book = AddrBook(
+                config.addr_book_file, our_id=self.node_key.id
+            )
+            sw.add_reactor("pex", PEXReactor(self.addr_book))
+
+        # --- rpc + metrics ---
+        self.rpc_server = None
+        self.metrics_server = None
+
+    # --- helpers ------------------------------------------------------------
+
+    def _listen_addr(self) -> str:
+        host, port = self._parse_laddr(self.config.p2p.laddr)
+        lp = getattr(self.transport, "listen_port", None) or port
+        return f"{host}:{lp}"
+
+    @staticmethod
+    def _parse_laddr(laddr: str) -> tuple[str, int]:
+        s = laddr.removeprefix("tcp://")
+        host, _, port = s.rpartition(":")
+        return host or "127.0.0.1", int(port or 0)
+
+    # --- mode switches (node.go:1612-1632) -----------------------------------
+
+    async def _switch_to_sequencer_mode(self, state) -> None:
+        self.logger.info(
+            "switching to sequencer mode", height=state.last_block_height
+        )
+        if hasattr(self.l2_node, "seed_v2_height"):
+            # the mock L2 needs its v2 chain aligned to the BFT height;
+            # a real geth already is
+            self.l2_node.seed_v2_height(state.last_block_height)
+        await self.sequencer_reactor.start_sequencer_routines()
+
+    async def _switch_to_consensus(self, state) -> None:
+        self.logger.info(
+            "blocksync caught up; starting consensus",
+            height=state.last_block_height,
+        )
+        self.consensus.state = state
+        await self.consensus.start()
+
+    # --- lifecycle (node.go:1041-1112) ---------------------------------------
+
+    async def on_start(self) -> None:
+        await self.proxy_app.start()
+        if self.indexer_service is not None:
+            await self.indexer_service.start()
+        # handshake/replay: sync app + L2 with the block store
+        from ..consensus.replay import Handshaker
+
+        hs = Handshaker(
+            self.state_store,
+            self.block_store,
+            self.genesis,
+            self.block_executor,
+            logger=self.logger,
+        )
+        state = await hs.handshake(self.consensus.state)
+        self.consensus.state = state
+        self.blocksync_reactor.state = state
+
+        # rpc
+        if self.config.rpc.laddr:
+            from ..rpc.server import RPCServer
+
+            host, port = self._parse_laddr(self.config.rpc.laddr)
+            self.rpc_server = RPCServer(self, host, port)
+            await self.rpc_server.start()
+        # metrics
+        if self.config.instrumentation.prometheus:
+            from ..libs.metrics import MetricsServer
+
+            host, port = self._parse_laddr(
+                self.config.instrumentation.prometheus_listen_addr
+            )
+            self.metrics_server = MetricsServer(
+                self.metrics_registry, host or "0.0.0.0", port
+            )
+            await self.metrics_server.start()
+
+        # pre-build the validator table cache off the critical path (the
+        # steady-state vote path then never pays decompression/table cost)
+        vals = self.consensus.state.validators
+        if (
+            vals is not None
+            and hasattr(self.consensus.verifier, "warm")
+            and not os.environ.get("TM_TPU_SKIP_WARM")
+        ):
+            pubs = [v.pub_key.data for v in vals.validators]
+            # daemon thread: the build may include a device compile and
+            # must neither block the event loop nor delay shutdown
+            import threading as _threading
+
+            _threading.Thread(
+                target=self.consensus.verifier.warm,
+                args=(pubs,),
+                daemon=True,
+                name="verifier-warm",
+            ).start()
+
+        # p2p
+        host, port = self._parse_laddr(self.config.p2p.laddr)
+        await self.transport.listen(host, port)
+        await self.switch.start()
+        peers = [
+            NetAddress.parse(p)
+            for p in self.config.p2p.peer_list(
+                self.config.p2p.persistent_peers
+            )
+        ]
+        if peers:
+            self.switch.dial_peers_async(peers, persistent=True)
+
+        # consensus (blocksync/statesync first when configured)
+        if self.config.statesync.enable:
+            self.spawn(self._run_statesync())
+        elif peers and self.config.blocksync.enable:
+            self.blocksync_reactor.start_sync()
+        else:
+            await self.consensus.start()
+
+    async def _run_statesync(self) -> None:
+        """Bootstrap from a snapshot, then hand off to consensus
+        (node.go:1088-1106 startStateSync)."""
+        from ..statesync.syncer import Syncer
+        from ..statesync.stateprovider import LightClientStateProvider
+        from ..light.client import LightClient, TrustOptions
+        from ..light.store import LightStore
+        from ..rpc.light_provider import RPCProvider
+
+        servers = [
+            s.strip()
+            for s in self.config.statesync.rpc_servers.split(",")
+            if s.strip()
+        ]
+        providers = [RPCProvider(self.genesis.chain_id, s) for s in servers]
+        lc = LightClient(
+            self.genesis.chain_id,
+            TrustOptions(
+                int(self.config.statesync.trust_period * 1e9),
+                self.config.statesync.trust_height,
+                bytes.fromhex(self.config.statesync.trust_hash),
+            ),
+            providers[0],
+            providers[1:],
+            LightStore(MemKV()),
+            logger=self.logger,
+        )
+        provider = LightClientStateProvider(
+            lc, consensus_params=self.consensus.state.consensus_params
+        )
+        syncer = Syncer(
+            self.app,
+            provider,
+            self.statesync_reactor.request_chunk,
+            logger=self.logger,
+        )
+        self.statesync_reactor.syncer = syncer
+        state, commit = await syncer.sync_any(
+            discovery_time=self.config.statesync.discovery_time
+        )
+        self.statesync_reactor.syncer = None
+        self.state_store.bootstrap(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        self.consensus.state = state
+        await self.consensus.start()
+
+    async def on_stop(self) -> None:
+        if self.consensus.is_running:
+            await self.consensus.stop()
+        if self.sequencer_reactor.sequencer_started:
+            await self.sequencer_reactor.on_stop()
+        await self.switch.stop()
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
+        if self.metrics_server is not None:
+            await self.metrics_server.stop()
+        if self.indexer_service is not None:
+            await self.indexer_service.stop()
+        await self.proxy_app.stop()

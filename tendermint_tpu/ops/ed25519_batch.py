@@ -7,12 +7,18 @@ once and returns an accept bitmap. No early exit, no branches — rejects are
 masks, which is the TPU-friendly replacement for the reference's
 ``return false`` paths.
 
+Two paths:
+- `verify_prehashed`: generic — decompresses each pubkey and builds its
+  window table in-batch.
+- `verify_prehashed_table`: the consensus hot path — takes prebuilt cached
+  window tables for the pubkeys (the same validators sign every height, so
+  the BatchVerifier builds each validator's table once and re-uses it;
+  skips decompression + table construction, ~40% of the generic work).
+
 The kernel takes *prehashed* challenges: k = SHA-512(R || A || M) mod L is
-computed by the caller (host today, on-device sha512 kernel as it lands —
-ops/sha512.py) because the per-vote message is ragged while everything in
-here is fixed-shape. The s < L range check is likewise a host-computed input
-mask (`s_ok`): s is attacker-controlled bytes and the check is a trivial
-256-bit compare.
+computed by the caller (host today — the per-vote message is ragged while
+everything in here is fixed-shape). The s < L range check is likewise a
+host-computed input mask (`s_ok`).
 
 Verification equation (cofactorless, matching Go x/crypto semantics):
     [s]B == R + [k]A   ⇔   encode([s]B + [k](-A)) == R_bytes
@@ -41,4 +47,30 @@ def verify_prehashed(
     return a_valid & s_ok & r_match
 
 
+def neg_pubkey_table(pubkeys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Build cached window tables for -A per pubkey.
+
+    pubkeys: [N, 32] u8 -> (tables [N, 16, 4, 32] i32, valid [N] bool).
+    One-time per validator; the verify path then runs table-only.
+    """
+    a_point, a_valid = curve.decompress(pubkeys)
+    return curve.window_table(curve.neg(a_point)), a_valid
+
+
+def verify_prehashed_table(
+    tables: jnp.ndarray,  # [B, 16, 4, 32] cached window tables of -A
+    table_valid: jnp.ndarray,  # [B] bool (pubkey decompressed OK)
+    r_bytes: jnp.ndarray,  # [B, 32] uint8
+    s_bytes: jnp.ndarray,  # [B, 32] uint8
+    k_bytes: jnp.ndarray,  # [B, 32] uint8
+    s_ok: jnp.ndarray,  # [B] bool
+) -> jnp.ndarray:
+    """Returns [B] bool accept bitmap (cached-pubkey hot path)."""
+    q = curve.double_scalar_mult_base_table(s_bytes, k_bytes, tables)
+    encoded = curve.compress(q)
+    r_match = jnp.all(encoded == r_bytes, axis=-1)
+    return table_valid & s_ok & r_match
+
+
 verify_prehashed_jit = jax.jit(verify_prehashed)
+verify_prehashed_table_jit = jax.jit(verify_prehashed_table)

@@ -1,0 +1,461 @@
+"""RPC core — the route table over node internals.
+
+Reference: rpc/core/routes.go:10-43 (the morph fork's table: the mempool
+broadcast routes are deleted along with the mempool) + rpc/core/*.go
+handlers reading the node environment (node/node.go:1174-1200). Bytes are
+hex-encoded in results (the reference mixes hex and base64; hex
+throughout keeps the surface predictable).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..types.event_bus import Query
+
+
+def _hex(b: bytes) -> str:
+    return b.hex().upper()
+
+
+class RPCCore:
+    def __init__(self, node):
+        self.node = node
+
+    # --- route table (reference routes.go:10-43) ----------------------------
+
+    def routes(self) -> dict:
+        return {
+            # info
+            "health": self.health,
+            "status": self.status,
+            "net_info": self.net_info,
+            "blockchain": self.blockchain,
+            "genesis": self.genesis,
+            "block": self.block,
+            "block_by_hash": self.block_by_hash,
+            "block_results": self.block_results,
+            "commit": self.commit,
+            "validators": self.validators,
+            "consensus_state": self.consensus_state,
+            "dump_consensus_state": self.dump_consensus_state,
+            "consensus_params": self.consensus_params,
+            "tx": self.tx,
+            "tx_search": self.tx_search,
+            "block_search": self.block_search,
+            # abci
+            "abci_info": self.abci_info,
+            "abci_query": self.abci_query,
+            # evidence
+            "broadcast_evidence": self.broadcast_evidence,
+            # help
+            "help": lambda: {"routes": sorted(self.routes())},
+        }
+
+    # --- handlers ------------------------------------------------------------
+
+    def health(self) -> dict:
+        return {}
+
+    def status(self) -> dict:
+        n = self.node
+        bs = n.block_store
+        latest_h = bs.height
+        meta = bs.load_block_meta(latest_h) if latest_h else None
+        pv_pub = n.priv_validator.get_pub_key()
+        return {
+            "node_info": {
+                "id": n.node_key.id,
+                "listen_addr": n._listen_addr(),
+                "network": n.genesis.chain_id,
+                "moniker": n.config.base.moniker,
+            },
+            "sync_info": {
+                "latest_block_height": latest_h,
+                "latest_block_hash": _hex(meta.block_id.hash) if meta else "",
+                "latest_app_hash": _hex(meta.header.app_hash) if meta else "",
+                "latest_block_time": meta.header.time_ns if meta else 0,
+                "catching_up": not n.consensus.is_running,
+            },
+            "validator_info": {
+                "address": _hex(pv_pub.address()),
+                "pub_key": _hex(pv_pub.data),
+                "voting_power": self._own_power(pv_pub),
+            },
+        }
+
+    def _own_power(self, pub) -> int:
+        vals = self.node.consensus.state.validators
+        if vals is None:
+            return 0
+        _, val = vals.get_by_address(pub.address())
+        return val.voting_power if val else 0
+
+    def net_info(self) -> dict:
+        sw = self.node.switch
+        return {
+            "listening": True,
+            "n_peers": len(sw.peers),
+            "peers": [
+                {
+                    "node_info": {
+                        "id": p.id,
+                        "listen_addr": p.node_info.listen_addr,
+                        "moniker": p.node_info.moniker,
+                    },
+                    "is_outbound": p.outbound,
+                    "remote_ip": p.socket_addr.host,
+                }
+                for p in sw.peers.values()
+            ],
+        }
+
+    def blockchain(self, minHeight=None, maxHeight=None, **_kw) -> dict:
+        bs = self.node.block_store
+        max_h = int(maxHeight) if maxHeight else bs.height
+        max_h = min(max_h, bs.height)
+        min_h = max(int(minHeight) if minHeight else 1, bs.base)
+        min_h = max(min_h, max_h - 19)  # reference caps at 20 metas
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            m = bs.load_block_meta(h)
+            if m:
+                metas.append(self._meta_json(m))
+        return {"last_height": bs.height, "block_metas": metas}
+
+    def genesis(self) -> dict:
+        return {"genesis": self.node.genesis.to_json()}
+
+    def block(self, height=None, **_kw) -> dict:
+        bs = self.node.block_store
+        h = int(height) if height else bs.height
+        blk = bs.load_block(h)
+        if blk is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, f"no block at height {h}")
+        meta = bs.load_block_meta(h)
+        return {
+            "block_id": self._bid_json(meta.block_id),
+            "block": self._block_json(blk),
+        }
+
+    def block_by_hash(self, hash=None, **_kw) -> dict:
+        bs = self.node.block_store
+        h_bytes = bytes.fromhex(hash) if hash else b""
+        blk = bs.load_block_by_hash(h_bytes)
+        if blk is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, "block not found")
+        meta = bs.load_block_meta(blk.header.height)
+        return {
+            "block_id": self._bid_json(meta.block_id),
+            "block": self._block_json(blk),
+        }
+
+    def block_results(self, height=None, **_kw) -> dict:
+        ss = self.node.state_store
+        bs = self.node.block_store
+        h = int(height) if height else bs.height
+        raw = ss.load_abci_responses(h)
+        if raw is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, f"no results for height {h}")
+        from ..state.execution import ABCIResponses
+
+        resp = ABCIResponses.decode(raw)
+        return {
+            "height": h,
+            "txs_results": [
+                {"code": r.code, "data": _hex(r.data), "log": r.log,
+                 "events": [
+                     {"type": e.type, "attributes": e.attributes}
+                     for e in r.events
+                 ]}
+                for r in resp.deliver_txs
+            ],
+        }
+
+    def commit(self, height=None, **_kw) -> dict:
+        bs = self.node.block_store
+        h = int(height) if height else bs.height
+        blk = bs.load_block(h)
+        commit = bs.load_seen_commit(h) if h == bs.height else None
+        if commit is None:
+            nxt = bs.load_block(h + 1)
+            commit = nxt.last_commit if nxt else bs.load_seen_commit(h)
+        if blk is None or commit is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, f"no commit at height {h}")
+        return {
+            "signed_header": {
+                "header": self._header_json(blk.header),
+                "commit": self._commit_json(commit),
+            },
+            "canonical": True,
+        }
+
+    def validators(self, height=None, **_kw) -> dict:
+        ss = self.node.state_store
+        h = int(height) if height else self.node.block_store.height
+        vals = ss.load_validators(h)
+        if vals is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, f"no validators at height {h}")
+        return {
+            "block_height": h,
+            "validators": [
+                {
+                    "address": _hex(v.address),
+                    "pub_key": _hex(v.pub_key.data),
+                    "pub_key_type": getattr(
+                        v.pub_key, "type_name", "ed25519"
+                    ),
+                    "voting_power": v.voting_power,
+                    "proposer_priority": v.proposer_priority,
+                }
+                for v in vals.validators
+            ],
+            "count": vals.size(),
+            "total": vals.size(),
+        }
+
+    def consensus_state(self) -> dict:
+        cs = self.node.consensus
+        rs = cs.rs
+        return {
+            "round_state": {
+                "height": rs.height,
+                "round": rs.round,
+                "step": int(rs.step),
+                "proposal": rs.proposal is not None,
+                "locked_round": rs.locked_round,
+                "valid_round": rs.valid_round,
+            }
+        }
+
+    def dump_consensus_state(self) -> dict:
+        out = self.consensus_state()
+        out["peers"] = [
+            {"node_address": p.id} for p in self.node.switch.peers.values()
+        ]
+        return out
+
+    def consensus_params(self, height=None, **_kw) -> dict:
+        state = self.node.consensus.state
+        cp = state.consensus_params
+        return {
+            "block_height": int(height) if height else state.last_block_height,
+            "consensus_params": {
+                "block": {"max_bytes": cp.block.max_bytes},
+                "evidence": {
+                    "max_age_num_blocks": cp.evidence.max_age_num_blocks,
+                    "max_age_duration": cp.evidence.max_age_duration_ns,
+                    "max_bytes": cp.evidence.max_bytes,
+                },
+                "batch": {
+                    "blocks_interval": cp.batch.blocks_interval,
+                    "timeout": cp.batch.timeout_ns,
+                },
+            },
+        }
+
+    def tx(self, hash=None, prove=False, **_kw) -> dict:
+        idx = getattr(self.node, "indexer", None)
+        if idx is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, "tx indexing is disabled")
+        res = idx.get_tx(bytes.fromhex(hash))
+        if res is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, "tx not found")
+        return self._tx_result_json(res, hash)
+
+    def tx_search(self, query="", page=1, per_page=30, **_kw) -> dict:
+        idx = getattr(self.node, "indexer", None)
+        if idx is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, "tx indexing is disabled")
+        results = idx.search_txs(query, limit=int(per_page))
+        return {
+            "txs": [
+                self._tx_result_json(r, None) for r in results
+            ],
+            "total_count": len(results),
+        }
+
+    def block_search(self, query="", page=1, per_page=30, **_kw) -> dict:
+        idx = getattr(self.node, "indexer", None)
+        if idx is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, "tx indexing is disabled")
+        heights = idx.search_blocks(query, limit=int(per_page))
+        bs = self.node.block_store
+        blocks = []
+        for h in heights:
+            m = bs.load_block_meta(h)
+            if m:
+                blocks.append(self._meta_json(m))
+        return {"blocks": blocks, "total_count": len(blocks)}
+
+    def abci_info(self) -> dict:
+        info = self.node.app.info()
+        return {
+            "response": {
+                "data": info.data,
+                "version": info.version,
+                "last_block_height": info.last_block_height,
+                "last_block_app_hash": _hex(info.last_block_app_hash),
+            }
+        }
+
+    def abci_query(self, path="", data="", height=0, prove=False, **_kw):
+        res = self.node.app.query(
+            path, bytes.fromhex(data) if data else b"", int(height), bool(prove)
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "key": _hex(res.key),
+                "value": _hex(res.value),
+                "height": res.height,
+            }
+        }
+
+    def broadcast_evidence(self, evidence="", **_kw) -> dict:
+        from ..types.evidence import decode_evidence
+
+        ev = decode_evidence(bytes.fromhex(evidence))
+        self.node.evidence_pool.add_evidence(ev)
+        return {"hash": _hex(ev.hash())}
+
+    # --- event subscriptions (websocket) -------------------------------------
+
+    def subscribe_ws(self, client_id, query_str: str):
+        return self.node.event_bus.subscribe(
+            f"ws-{client_id}", Query(query_str)
+        )
+
+    def unsubscribe_ws(self, client_id, query_str: str) -> None:
+        try:
+            self.node.event_bus.unsubscribe(
+                f"ws-{client_id}", Query(query_str)
+            )
+        except Exception:
+            pass
+
+    def encode_event(self, msg) -> dict:
+        """Best-effort JSON encoding of a bus message's data payload."""
+        data = msg.data
+        from ..types.block import Block, Header
+
+        if isinstance(data, Block):
+            return {"type": "block", "value": self._block_json(data)}
+        if isinstance(data, Header):
+            return {"type": "header", "value": self._header_json(data)}
+        if isinstance(data, tuple) and len(data) == 3:
+            height, tx_hash, tx = data
+            return {
+                "type": "tx",
+                "value": {
+                    "height": height,
+                    "hash": _hex(tx_hash),
+                    "tx": _hex(tx),
+                },
+            }
+        return {"type": type(data).__name__, "value": repr(data)}
+
+    # --- json helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _bid_json(bid) -> dict:
+        return {
+            "hash": _hex(bid.hash),
+            "parts": {
+                "total": bid.part_set_header.total,
+                "hash": _hex(bid.part_set_header.hash),
+            },
+        }
+
+    def _header_json(self, h) -> dict:
+        return {
+            "chain_id": h.chain_id,
+            "height": h.height,
+            "time": h.time_ns,
+            "last_block_id": self._bid_json(h.last_block_id),
+            "validators_hash": _hex(h.validators_hash),
+            "next_validators_hash": _hex(h.next_validators_hash),
+            "consensus_hash": _hex(h.consensus_hash),
+            "app_hash": _hex(h.app_hash),
+            "last_results_hash": _hex(h.last_results_hash),
+            "evidence_hash": _hex(h.evidence_hash),
+            "proposer_address": _hex(h.proposer_address),
+            "batch_hash": _hex(h.batch_hash),
+            "hash": _hex(h.hash()),
+        }
+
+    def _commit_json(self, c) -> dict:
+        return {
+            "height": c.height,
+            "round": c.round,
+            "block_id": self._bid_json(c.block_id),
+            "signatures": [
+                {
+                    "block_id_flag": int(s.block_id_flag),
+                    "validator_address": _hex(s.validator_address),
+                    "timestamp": s.timestamp_ns,
+                    "signature": _hex(s.signature),
+                    "bls_signature": _hex(s.bls_signature),
+                }
+                for s in c.signatures
+            ],
+        }
+
+    def _block_json(self, b) -> dict:
+        return {
+            "header": self._header_json(b.header),
+            "data": {
+                "txs": [_hex(tx) for tx in b.data.txs],
+                "l2_block_meta": _hex(b.data.l2_block_meta),
+                "l2_batch_header": _hex(b.data.l2_batch_header),
+            },
+            "evidence": [_hex(ev.encode()) for ev in b.evidence],
+            "last_commit": self._commit_json(b.last_commit)
+            if b.last_commit
+            else None,
+        }
+
+    def _meta_json(self, m) -> dict:
+        return {
+            "block_id": self._bid_json(m.block_id),
+            "block_size": m.block_size,
+            "header": self._header_json(m.header),
+            "num_txs": m.num_txs,
+        }
+
+    def _tx_result_json(self, r, tx_hash) -> dict:
+        from ..crypto import tmhash
+
+        return {
+            "hash": tx_hash or _hex(tmhash.sum(r.tx)),
+            "height": r.height,
+            "index": r.index,
+            "tx_result": {
+                "code": r.code,
+                "log": r.log,
+                "events": [
+                    {"type": t, "attributes": attrs} for t, attrs in r.events
+                ],
+            },
+            "tx": _hex(r.tx),
+        }

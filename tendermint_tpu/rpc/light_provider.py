@@ -1,0 +1,153 @@
+"""RPC-backed light-block provider + minimal JSON-RPC client.
+
+Reference: light/provider/http (provider over rpc/client/http). Fetches
+signed header + commit + validator set for a height from a node's RPC and
+assembles a LightBlock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+
+class RPCClient:
+    """Minimal JSON-RPC over HTTP POST client (reference rpc/client/http)."""
+
+    def __init__(self, addr: str):
+        # addr: "host:port" or "tcp://host:port" or "http://host:port"
+        s = addr
+        for prefix in ("tcp://", "http://"):
+            s = s.removeprefix(prefix)
+        host, _, port = s.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self._id = 0
+
+    async def call(self, method: str, **params):
+        self._id += 1
+        payload = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": self._id,
+                "method": method,
+                "params": params,
+            }
+        ).encode()
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            writer.write(
+                b"POST / HTTP/1.1\r\nHost: rpc\r\n"
+                b"Content-Type: application/json\r\nContent-Length: "
+                + str(len(payload)).encode()
+                + b"\r\nConnection: close\r\n\r\n"
+                + payload
+            )
+            await writer.drain()
+            # parse response
+            status = await reader.readline()
+            if b"200" not in status:
+                raise ConnectionError(f"rpc http error: {status!r}")
+            n = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                if line.lower().startswith(b"content-length:"):
+                    n = int(line.split(b":", 1)[1])
+            body = await reader.readexactly(n) if n else await reader.read()
+            resp = json.loads(body)
+            if resp.get("error"):
+                raise RuntimeError(f"rpc error: {resp['error']}")
+            return resp["result"]
+        finally:
+            writer.close()
+
+
+class RPCProvider:
+    """light.Provider over a node's RPC (reference light/provider/http)."""
+
+    def __init__(self, chain_id: str, addr: str):
+        self.chain_id = chain_id
+        self.client = RPCClient(addr)
+        self._addr = addr
+
+    def id(self) -> str:
+        return self._addr
+
+    async def light_block(self, height: int):
+        from ..light.types import LightBlock
+        from ..types.block import Commit, Header
+        from ..types.block_id import BlockID
+        from ..types.part_set import PartSetHeader
+        from ..types.block import BlockIDFlag, CommitSig
+        from ..types.validator import Validator, pubkey_from_type
+        from ..types.validator_set import ValidatorSet
+
+        try:
+            c = await self.client.call(
+                "commit", height=height if height else None
+            )
+            v = await self.client.call(
+                "validators", height=height if height else None
+            )
+        except (ConnectionError, RuntimeError, OSError):
+            return None
+        hdr = c["signed_header"]["header"]
+        cm = c["signed_header"]["commit"]
+        header = Header(
+            chain_id=hdr["chain_id"],
+            height=hdr["height"],
+            time_ns=hdr["time"],
+            last_block_id=BlockID(
+                hash=bytes.fromhex(hdr["last_block_id"]["hash"]),
+                part_set_header=PartSetHeader(
+                    hdr["last_block_id"]["parts"]["total"],
+                    bytes.fromhex(hdr["last_block_id"]["parts"]["hash"]),
+                ),
+            ),
+            validators_hash=bytes.fromhex(hdr["validators_hash"]),
+            next_validators_hash=bytes.fromhex(hdr["next_validators_hash"]),
+            consensus_hash=bytes.fromhex(hdr["consensus_hash"]),
+            app_hash=bytes.fromhex(hdr["app_hash"]),
+            last_results_hash=bytes.fromhex(hdr["last_results_hash"]),
+            evidence_hash=bytes.fromhex(hdr["evidence_hash"]),
+            proposer_address=bytes.fromhex(hdr["proposer_address"]),
+            batch_hash=bytes.fromhex(hdr.get("batch_hash", "")),
+        )
+        commit = Commit(
+            height=cm["height"],
+            round=cm["round"],
+            block_id=BlockID(
+                hash=bytes.fromhex(cm["block_id"]["hash"]),
+                part_set_header=PartSetHeader(
+                    cm["block_id"]["parts"]["total"],
+                    bytes.fromhex(cm["block_id"]["parts"]["hash"]),
+                ),
+            ),
+            signatures=[
+                CommitSig(
+                    block_id_flag=s["block_id_flag"],
+                    validator_address=bytes.fromhex(s["validator_address"]),
+                    timestamp_ns=s["timestamp"],
+                    signature=bytes.fromhex(s["signature"]),
+                    bls_signature=bytes.fromhex(s.get("bls_signature", "")),
+                )
+                for s in cm["signatures"]
+            ],
+        )
+        vals = ValidatorSet(
+            [
+                Validator(
+                    pubkey_from_type(
+                        val.get("pub_key_type", "ed25519"),
+                        bytes.fromhex(val["pub_key"]),
+                    ),
+                    val["voting_power"],
+                    val.get("proposer_priority", 0),
+                )
+                for val in v["validators"]
+            ]
+        )
+        return LightBlock(header, commit, vals)

@@ -52,6 +52,20 @@ class ABCIResponses:
             }
         ).encode()
 
+    @classmethod
+    def decode(cls, data: bytes) -> "ABCIResponses":
+        obj = json.loads(data.decode())
+        out = cls()
+        for r in obj.get("deliver_txs", []):
+            out.deliver_txs.append(
+                abci.ResponseDeliverTx(
+                    code=r.get("code", 0),
+                    data=bytes.fromhex(r.get("data", "")),
+                    log=r.get("log", ""),
+                )
+            )
+        return out
+
 
 class BlockExecutor:
     def __init__(

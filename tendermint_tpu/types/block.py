@@ -370,6 +370,11 @@ class Block:
     def hash(self) -> bytes:
         return self.header.hash()
 
+    def is_batch_point(self) -> bool:
+        """True if this block seals an L2 batch (reference
+        types/block.go IsBatchPoint: non-empty BatchHash)."""
+        return bool(self.header.batch_hash)
+
     def set_batch_point(self, batch_hash: bytes, batch_header: bytes) -> None:
         """Mark this block as a batch point (morph decideBatchPoint):
         mutates header.batch_hash + data.l2_batch_header and keeps the

