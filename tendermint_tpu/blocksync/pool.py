@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..libs.log import Logger, nop_logger
-from ..types.block import Block
+from ..types.block import Block, Commit
 
 REQUEST_WINDOW = 40  # max heights in flight (reference maxPendingRequests)
 REQUEST_TIMEOUT = 8.0
@@ -164,6 +164,24 @@ class BlockPool:
             first.block if first else None,
             second.block if second else None,
         )
+
+    def peek_window(self, max_blocks: int) -> list[tuple[Block, "Commit"]]:
+        """[(block, successor_last_commit)] for consecutive ready blocks
+        from `height` — each block paired with the commit that verifies it
+        (the multi-block batched-verify window, SURVEY.md §3.4). Stops at
+        the first gap or successor without a last commit."""
+        out = []
+        h = self.height
+        while len(out) < max_blocks:
+            r = self._requesters.get(h)
+            nxt = self._requesters.get(h + 1)
+            if r is None or r.block is None or nxt is None or nxt.block is None:
+                break
+            if nxt.block.last_commit is None:
+                break  # undecodable/hostile successor; per-block path rejects
+            out.append((r.block, nxt.block.last_commit))
+            h += 1
+        return out
 
     def pop_request(self) -> None:
         self._requesters.pop(self.height, None)

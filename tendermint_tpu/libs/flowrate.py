@@ -1,0 +1,65 @@
+"""Flow-rate monitoring (reference libs/flowrate/flowrate.go).
+
+Tracks transfer rate over a sliding EMA window; MConnection throttling
+and the blocksync pool's peer-rate checks use `status().cur_rate`.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class Status:
+    start: float
+    bytes_total: int
+    cur_rate: float  # bytes/sec over the sample window
+    avg_rate: float
+    peak_rate: float
+    duration: float
+
+
+class Monitor:
+    def __init__(self, sample_period: float = 0.1, window: float = 1.0):
+        self._sample = sample_period
+        self._alpha = sample_period / window
+        self._start = time.monotonic()
+        self._total = 0
+        self._acc = 0  # bytes since last sample
+        self._last = self._start
+        self._rate = 0.0
+        self._peak = 0.0
+
+    def update(self, n: int) -> None:
+        self._total += n
+        self._acc += n
+        now = time.monotonic()
+        dt = now - self._last
+        if dt >= self._sample:
+            inst = self._acc / dt
+            self._rate += self._alpha * (inst - self._rate)
+            self._peak = max(self._peak, self._rate)
+            self._acc = 0
+            self._last = now
+
+    def status(self) -> Status:
+        now = time.monotonic()
+        dur = now - self._start
+        return Status(
+            start=self._start,
+            bytes_total=self._total,
+            cur_rate=self._rate,
+            avg_rate=self._total / dur if dur > 0 else 0.0,
+            peak_rate=self._peak,
+            duration=dur,
+        )
+
+    def limit(self, want: int, max_rate: float) -> int:
+        """How many of `want` bytes may transfer now to stay under
+        max_rate (0 = unlimited)."""
+        if max_rate <= 0:
+            return want
+        dur = time.monotonic() - self._start
+        budget = max_rate * (dur + self._sample) - self._total
+        return max(0, min(want, int(budget)))

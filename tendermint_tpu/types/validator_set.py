@@ -250,6 +250,54 @@ class ValidatorSet:
             idxs.append(i)
         return items, idxs
 
+    def verify_commits_light(
+        self,
+        chain_id: str,
+        entries: list,
+        verifier: Optional[BatchVerifier] = None,
+    ) -> list[bool]:
+        """Light-verify MANY commits as ONE device batch.
+
+        entries: [(block_id, height, commit)]. Returns a per-commit verdict
+        list (no exception per commit — callers fall back per entry). This
+        is the blocksync/light bulk shape (SURVEY.md §3.4: pipeline many
+        blocks' commits as one sharded batch instead of one device call per
+        block; reference loops serially at blocksync/reactor.go:553).
+        All commits must be against THIS validator set — callers batch
+        only across heights with an unchanged set.
+        """
+        verifier = verifier or default_verifier()
+        all_items: list[SigItem] = []
+        spans = []  # (start, idxs); idxs=None -> malformed entry
+        for block_id, height, commit in entries:
+            try:
+                if commit is None:
+                    raise ValueError("nil commit")
+                self._check_commit_shape(block_id, height, commit)
+            except ValueError:
+                spans.append((len(all_items), None))
+                continue
+            items, idxs = self._gather_items(chain_id, commit, True)
+            spans.append((len(all_items), idxs))
+            all_items.extend(items)
+        ok = verifier.verify(all_items) if all_items else []
+        out = []
+        for start, idxs in spans:
+            if idxs is None:
+                out.append(False)
+                continue
+            tallied = sum(
+                self.validators[i].voting_power
+                for valid, i in zip(ok[start : start + len(idxs)], idxs)
+                if valid
+            )
+            try:
+                self._check_maj23(tallied)
+                out.append(True)
+            except ValueError:
+                out.append(False)
+        return out
+
     def verify_commit(
         self,
         chain_id: str,

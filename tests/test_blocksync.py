@@ -1,0 +1,231 @@
+"""Blocksync: pool mechanics, windowed batched verify, p2p fast-sync e2e.
+
+VERDICT round-1 weak item 4: blocksync shipped untested. These drive the
+pool + reactor verify-then-apply loop over real p2p, including the
+multi-block batched commit path (SURVEY.md §3.4).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.blocksync.pool import BlockPool
+from tendermint_tpu.blocksync.reactor import BlocksyncReactor
+from tendermint_tpu.l2node.mock import MockL2Node
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.transport import MultiplexTransport, NetAddress
+
+from .helpers import make_genesis, make_validators
+from .test_consensus import make_node
+
+NETWORK = "bsync-chain"
+
+
+# --- pool ------------------------------------------------------------------
+
+
+def _fake_block(h):
+    class B:
+        def __init__(self, height):
+            self.header = type("H", (), {"height": height})()
+            self.last_commit = object()  # non-None for window pairing
+
+    return B(h)
+
+
+def test_pool_requests_and_windows():
+    sent = []
+    pool = BlockPool(
+        start_height=1,
+        send_request=lambda pid, h: sent.append((pid, h)) or True,
+        on_peer_error=lambda pid, reason: None,
+    )
+    pool.set_peer_range("p1", 0, 10)
+    pool.make_requests()
+    assert sent, "no requests made"
+    for h in range(1, 6):
+        pool.add_block("p1", _fake_block(h))
+    # window requires each block's successor to be present
+    w = pool.peek_window(10)
+    assert [b.header.height for b, _c in w] == [1, 2, 3, 4]
+    pool.pop_request()
+    w = pool.peek_window(2)
+    assert [b.header.height for b, _c in w] == [2, 3]
+
+
+def test_pool_redo_punishes_peer():
+    errors = []
+    pool = BlockPool(
+        start_height=1,
+        send_request=lambda pid, h: True,
+        on_peer_error=lambda pid, reason: errors.append(pid),
+    )
+    pool.set_peer_range("bad", 0, 5)
+    pool.make_requests()
+    pool.add_block("bad", _fake_block(1))
+    pool.add_block("bad", _fake_block(2))
+    pool.redo_request(1, "bad block")
+    assert "bad" in errors
+
+
+# --- batched multi-commit verification -------------------------------------
+
+
+def test_verify_commits_light_batches_many_heights():
+    """One device batch covers many commits; invalid ones flagged
+    individually (ValidatorSet.verify_commits_light)."""
+    from tendermint_tpu.crypto.batch_verifier import BatchVerifier
+    from tendermint_tpu.types.block_id import BlockID
+    from tendermint_tpu.types.part_set import PartSetHeader
+
+    from .helpers import CHAIN_ID, sign_commit
+
+    vs, pvs = make_validators(4)
+    entries = []
+    for h in range(1, 9):
+        bid = BlockID(bytes([h]) * 32, PartSetHeader(1, bytes([h]) * 32))
+        commit = sign_commit(vs, pvs, h, 0, bid)
+        entries.append((bid, h, commit))
+    # corrupt height 5's commit
+    bad = entries[4][2]
+    bad.signatures[0].signature = b"\x00" * 64
+    bad.signatures[1].signature = b"\x00" * 64
+    bad.signatures[2].signature = b"\x00" * 64
+
+    verifier = BatchVerifier(min_device_batch=1 << 30)  # host path
+    verdicts = vs.verify_commits_light(CHAIN_ID, entries, verifier=verifier)
+    assert verdicts == [True] * 4 + [False] + [True] * 3
+
+
+# --- e2e fast sync over p2p -------------------------------------------------
+
+
+def _build_source_chain(n_heights):
+    """Run a single-validator chain to height n (in-proc) and return the
+    pieces a syncing node needs."""
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+
+    async def run():
+        cs, app, l2, bs, ss = make_node(vs, pvs[0], genesis)
+        await cs.start()
+        await cs.wait_for_height(n_heights, timeout=60)
+        await cs.stop()
+        return cs, bs
+
+    cs, bs = asyncio.run(run())
+    return vs, pvs, genesis, bs
+
+
+def test_fast_sync_over_p2p_catches_up():
+    """A fresh node blocksyncs a 8-height chain from a serving peer and
+    hands off to consensus (reference poolRoutine verify-then-apply +
+    SwitchToConsensus :461-485)."""
+    vs, pvs, genesis, src_bs = _build_source_chain(8)
+
+    def build_switch(reactors):
+        nk = NodeKey.generate()
+        transport = None
+        sw = None
+
+        def node_info():
+            return NodeInfo(
+                node_id=nk.id,
+                listen_addr=f"127.0.0.1:{transport.listen_port}",
+                network=NETWORK,
+                channels=sw.channels() if sw else b"",
+            )
+
+        transport = MultiplexTransport(nk, node_info)
+        sw = Switch(transport)
+        for name, r in reactors.items():
+            sw.add_reactor(name, r)
+        return nk, transport, sw
+
+    async def run():
+        # server: a reactor with the full block store (inactive pool)
+        from tendermint_tpu.state.state import State
+
+        caught_up = []
+        srv_cs, srv_app, srv_l2, srv_bs2, srv_ss = make_node(
+            vs, pvs[0], genesis
+        )
+        server_r = BlocksyncReactor(
+            srv_cs.state, srv_cs.executor, src_bs, srv_l2, active=False
+        )
+        snk, st_, ssw = build_switch({"blocksync": server_r})
+
+        # client: fresh node syncing from genesis
+        cli_cs, cli_app, cli_l2, cli_bs, cli_ss = make_node(
+            vs, pvs[0], genesis
+        )
+
+        async def on_caught_up(state):
+            caught_up.append(state.last_block_height)
+
+        client_r = BlocksyncReactor(
+            cli_cs.state,
+            cli_cs.executor,
+            cli_bs,
+            cli_l2,
+            on_caught_up=on_caught_up,
+            active=False,
+        )
+        cnk, ct, csw = build_switch({"blocksync": client_r})
+        for t, sw in ((st_, ssw), (ct, csw)):
+            await t.listen()
+            await sw.start()
+        await csw.dial_peer(NetAddress(snk.id, "127.0.0.1", st_.listen_port))
+        await asyncio.sleep(0.2)
+        client_r.start_sync()
+        for _ in range(200):
+            await asyncio.sleep(0.05)
+            if caught_up:
+                break
+        h = cli_bs.height
+        applied = client_r.blocks_applied
+        for sw in (ssw, csw):
+            await sw.stop()
+        return h, applied, caught_up
+
+    h, applied, caught_up = asyncio.run(run())
+    # blocks 1..7 apply (8 needs a successor commit; it arrives via
+    # consensus after handoff)
+    assert h >= 7, f"client only reached height {h}"
+    assert applied >= 7
+    assert caught_up, "on_caught_up never fired"
+
+
+def test_fast_sync_rejects_tampered_block():
+    """A peer serving a tampered block is punished and the height is
+    re-requested (reference redo + StopPeerForError)."""
+    import copy
+
+    vs, pvs, genesis, src_bs = _build_source_chain(5)
+
+    async def run():
+        cli_cs, cli_app, cli_l2, cli_bs, cli_ss = make_node(
+            vs, pvs[0], genesis
+        )
+        reactor = BlocksyncReactor(
+            cli_cs.state, cli_cs.executor, cli_bs, cli_l2, active=False
+        )
+        errors = []
+        reactor.pool._on_peer_error = lambda pid, reason: errors.append(pid)
+        reactor.pool.set_peer_range("evil", 0, 5)
+        reactor.pool.make_requests()
+        # evil serves block 1 with tampered txs, plus honest block 2
+        b1 = copy.deepcopy(src_bs.load_block(1))
+        b1.data.txs = [b"forged=1"]
+        b1.data._hash = None
+        b1.header._hash = None  # content changed -> hash changes
+        reactor.pool.add_block("evil", b1)
+        reactor.pool.add_block("evil", src_bs.load_block(2))
+        await reactor._process_ready_blocks()
+        return errors, cli_bs.height
+
+    errors, h = asyncio.run(run())
+    assert "evil" in errors, "tampered block did not punish the peer"
+    assert h == 0, "tampered block was applied"

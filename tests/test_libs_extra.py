@@ -1,0 +1,132 @@
+"""clist, flowrate, math, cmap, ethutil (reference libs/ + ethutil/)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.ethutil import (
+    LegacyTx,
+    decode_txs,
+    encode_transactions,
+    rlp_decode,
+    rlp_encode,
+)
+from tendermint_tpu.libs.clist import CList
+from tendermint_tpu.libs.cmap import CMap
+from tendermint_tpu.libs.flowrate import Monitor
+from tendermint_tpu.libs.math import (
+    ErrOverflow,
+    Fraction,
+    MAX_INT64,
+    safe_add_int64,
+    safe_mul_int64,
+)
+
+
+def test_clist_push_remove_iterate():
+    async def run():
+        cl = CList()
+        e1 = cl.push_back("a")
+        e2 = cl.push_back("b")
+        cl.push_back("c")
+        assert list(cl) == ["a", "b", "c"]
+        cl.remove(e2)
+        assert list(cl) == ["a", "c"]
+        assert len(cl) == 2
+        # waiting cursor wakes when a next element arrives
+        got = []
+
+        async def reader():
+            el = await cl.front_wait()
+            while el is not None:
+                got.append(el.value)
+                if len(got) == 3:
+                    return
+                el = await el.next_wait()
+
+        t = asyncio.create_task(reader())
+        await asyncio.sleep(0.01)
+        cl.push_back("d")
+        await asyncio.wait_for(t, 2)
+        assert got == ["a", "c", "d"]
+
+    asyncio.run(run())
+
+
+def test_flowrate_tracks_rate():
+    m = Monitor(sample_period=0.0)  # sample on every update
+    m.update(1000)
+    st = m.status()
+    assert st.bytes_total == 1000
+    assert st.avg_rate > 0
+    assert m.limit(500, max_rate=0) == 500  # unlimited
+
+
+def test_safe_math_and_fraction():
+    assert safe_add_int64(1, 2) == 3
+    with pytest.raises(ErrOverflow):
+        safe_add_int64(MAX_INT64, 1)
+    with pytest.raises(ErrOverflow):
+        safe_mul_int64(MAX_INT64, 2)
+    f = Fraction.parse("1/3")
+    assert f.numerator == 1 and f.denominator == 3
+    assert abs(float(f) - 1 / 3) < 1e-12
+    with pytest.raises(ZeroDivisionError):
+        Fraction(1, 0)
+
+
+def test_cmap():
+    m = CMap()
+    m.set("a", 1)
+    assert m.get("a") == 1 and m.has("a") and m.size() == 1
+    m.delete("a")
+    assert not m.has("a")
+
+
+# --- ethutil ----------------------------------------------------------------
+
+
+def test_rlp_roundtrip():
+    cases = [b"", b"\x01", b"dog", b"x" * 100, [b"cat", [b"a", b""]], []]
+    for c in cases:
+        enc = rlp_encode(c)
+        dec, rest = rlp_decode(enc)
+        assert rest == b""
+        assert dec == c
+    # canonical single-byte encoding
+    assert rlp_encode(b"\x05") == b"\x05"
+    assert rlp_encode(0) == b"\x80"
+    assert rlp_encode(1024) == b"\x82\x04\x00"
+
+
+def test_legacy_tx_sign_recover_roundtrip():
+    from tendermint_tpu.crypto import secp256k1
+
+    key = secp256k1.PrivKey.from_secret(b"eth-sender")
+    pt = secp256k1.decompress_point(key.public_key().data)
+    addr = secp256k1.eth_address(pt)
+
+    tx = LegacyTx(
+        nonce=7,
+        gas_price=10**9,
+        gas=21000,
+        to=b"\x11" * 20,
+        value=10**18,
+        data=b"",
+    )
+    tx.sign(key.secret, chain_id=2818)  # morph chain id
+    assert tx.chain_id() == 2818
+    assert tx.sender() == addr
+
+    # wire roundtrip preserves sender recovery
+    blob = encode_transactions([tx, tx])
+    txs = decode_txs(blob)
+    assert len(txs) == 2
+    for t in txs:
+        assert t.sender() == addr
+        assert t.nonce == 7 and t.value == 10**18
+
+    # tampered payload recovers a different sender
+    bad = decode_txs(blob)[0]
+    bad.value = 5
+    assert bad.sender() != addr
