@@ -127,7 +127,9 @@ def cmd_rollback(args) -> int:
     bs = BlockStore(SqliteKV(os.path.join(cfg.db_dir, "blockstore.db")))
     state = ss.rollback(bs)
     if args.hard:
-        bs.prune_blocks_since(state.last_block_height + 1)
+        # remove the rolled-back block too (prune_blocks_since removes
+        # blocks ABOVE the argument)
+        bs.prune_blocks_since(state.last_block_height)
     print(
         f"rolled back to height {state.last_block_height} "
         f"(app hash {state.app_hash.hex()})"
@@ -200,6 +202,227 @@ def cmd_show_validator(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """Run a light-client proxy (reference cmd light.go + light/proxy)."""
+    from .light.client import LightClient, TrustOptions
+    from .light.proxy import LightProxy
+    from .light.store import LightStore
+    from .rpc.light_provider import RPCProvider
+    from .store.kv import SqliteKV
+
+    os.makedirs(args.home, exist_ok=True)
+    store = LightStore(SqliteKV(os.path.join(args.home, "light.db")))
+    trust = None
+    if args.trusted_height and args.trusted_hash:
+        trust = TrustOptions(
+            int(args.trust_period * 1e9),
+            args.trusted_height,
+            bytes.fromhex(args.trusted_hash),
+        )
+    lc = LightClient(
+        args.chain_id,
+        trust,
+        RPCProvider(args.chain_id, args.primary),
+        [RPCProvider(args.chain_id, w) for w in args.witnesses.split(",") if w],
+        store,
+        sequential=args.sequential,
+    )
+    host, _, port = args.laddr.removeprefix("tcp://").rpartition(":")
+    proxy = LightProxy(lc, args.primary, host or "127.0.0.1", int(port))
+
+    async def run():
+        await proxy.start()
+        print(f"light proxy for {args.chain_id} on {args.laddr}")
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await proxy.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Print (and in --console mode, step through) the consensus WAL
+    (reference replay_file.go: RunReplayFile)."""
+    from .consensus.wal import WAL
+
+    cfg = _load_config(args)
+    wal_path = os.path.join(cfg.db_dir, "cs.wal")
+    if not os.path.exists(wal_path):
+        print(f"no WAL at {wal_path}")
+        return 1
+    wal = WAL(wal_path)
+    msgs = wal.search_for_end_height(0) or []
+    count = 0
+    for rec in msgs:
+        count += 1
+        print(f"#{count} {rec!r}")
+        if args.console:
+            input("  <enter> for next> ")
+    print(f"replayed {count} WAL records")
+    return 0
+
+
+def cmd_rewind(args) -> int:
+    """Rewind state + blocks to --height (reference rewind.go)."""
+    cfg = _load_config(args)
+    from .state.store import StateStore
+    from .store.block_store import BlockStore
+    from .store.kv import SqliteKV
+
+    ss = StateStore(SqliteKV(os.path.join(cfg.db_dir, "state.db")))
+    bs = BlockStore(SqliteKV(os.path.join(cfg.db_dir, "blockstore.db")))
+    state = ss.load()
+    if state is None:
+        print("no state to rewind")
+        return 1
+    target = args.height
+    while state.last_block_height > target:
+        state = ss.rollback(bs)
+    bs.prune_blocks_since(state.last_block_height)
+    print(f"rewound to height {state.last_block_height}")
+    return 0
+
+
+def cmd_compact(args) -> int:
+    """VACUUM the sqlite stores (reference compact.go's goleveldb
+    compaction)."""
+    import sqlite3
+
+    cfg = _load_config(args)
+    for name in ("state.db", "blockstore.db", "evidence.db", "tx_index.db"):
+        path = os.path.join(cfg.db_dir, name)
+        if os.path.exists(path):
+            before = os.path.getsize(path)
+            conn = sqlite3.connect(path)
+            conn.execute("VACUUM")
+            conn.close()
+            print(f"{name}: {before} -> {os.path.getsize(path)} bytes")
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """Rebuild the tx/block index from stored blocks + ABCI responses
+    (reference reindex_event.go)."""
+    cfg = _load_config(args)
+    from .state.execution import ABCIResponses
+    from .state.store import StateStore
+    from .state.txindex import KVIndexer, TxResult
+    from .store.block_store import BlockStore
+    from .store.kv import SqliteKV
+
+    ss = StateStore(SqliteKV(os.path.join(cfg.db_dir, "state.db")))
+    bs = BlockStore(SqliteKV(os.path.join(cfg.db_dir, "blockstore.db")))
+    ix = KVIndexer(SqliteKV(os.path.join(cfg.db_dir, "tx_index.db")))
+    start = args.start_height or bs.base
+    end = args.end_height or bs.height
+    n_tx = 0
+    for h in range(start, end + 1):
+        blk = bs.load_block(h)
+        if blk is None:
+            continue
+        raw = ss.load_abci_responses(h)
+        results = ABCIResponses.decode(raw).deliver_txs if raw else []
+        # block-level (begin/end-block) events are not persisted in
+        # ABCIResponses, so only tx events can be rebuilt offline
+        for i, tx in enumerate(blk.data.txs):
+            res = results[i] if i < len(results) else None
+            ix.index_tx(
+                TxResult(
+                    height=h,
+                    index=i,
+                    tx=tx,
+                    code=res.code if res else 0,
+                    log=res.log if res else "",
+                    events=[
+                        (e.type, e.attributes) for e in res.events
+                    ] if res else [],
+                )
+            )
+            n_tx += 1
+    print(f"reindexed heights [{start},{end}]: {n_tx} txs")
+    return 0
+
+
+def cmd_debug_dump(args) -> int:
+    """Snapshot node debug state to a directory (reference
+    cmd/tendermint/commands/debug: dump)."""
+    from .rpc.light_provider import RPCClient
+
+    os.makedirs(args.output, exist_ok=True)
+
+    async def run() -> int:
+        rpc = RPCClient(args.rpc_laddr)
+        for method in (
+            "status",
+            "net_info",
+            "consensus_state",
+            "dump_consensus_state",
+        ):
+            try:
+                res = await rpc.call(method)
+            except Exception as e:
+                res = {"error": str(e)}
+            with open(os.path.join(args.output, f"{method}.json"), "w") as f:
+                json.dump(res, f, indent=2)
+        if args.pprof_laddr:
+            host, _, port = (
+                args.pprof_laddr.removeprefix("tcp://").rpartition(":")
+            )
+            for route in ("goroutine", "heap"):
+                try:
+                    reader, writer = await asyncio.open_connection(
+                        host or "127.0.0.1", int(port)
+                    )
+                    writer.write(
+                        f"GET /debug/pprof/{route} HTTP/1.1\r\n"
+                        f"Host: x\r\n\r\n".encode()
+                    )
+                    await writer.drain()
+                    data = await reader.read()
+                    writer.close()
+                    body = data.split(b"\r\n\r\n", 1)[-1]
+                    with open(
+                        os.path.join(args.output, f"{route}.txt"), "wb"
+                    ) as f:
+                        f.write(body)
+                except (ConnectionError, OSError) as e:
+                    print(f"pprof {route}: {e}")
+        return 0
+
+    rc = asyncio.run(run())
+    print(f"wrote debug dump to {args.output}")
+    return rc
+
+
+def cmd_probe_upnp(args) -> int:
+    """SSDP-probe for a UPnP gateway (reference probe_upnp.go). Prints
+    the discovery outcome; NAT traversal is not attempted beyond this."""
+    import socket
+
+    msg = (
+        b"M-SEARCH * HTTP/1.1\r\nHOST: 239.255.255.250:1900\r\n"
+        b'MAN: "ssdp:discover"\r\nMX: 2\r\n'
+        b"ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1\r\n\r\n"
+    )
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(3.0)
+    try:
+        s.sendto(msg, ("239.255.255.250", 1900))
+        data, addr = s.recvfrom(4096)
+        print(f"UPnP gateway at {addr[0]}:\n{data.decode(errors='replace')}")
+        return 0
+    except (socket.timeout, OSError) as e:
+        print(f"no UPnP gateway found ({e})")
+        return 1
+    finally:
+        s.close()
+
+
 def cmd_version(args) -> int:
     print(
         f"tendermint-tpu {TMCORE_SEM_VER} "
@@ -263,6 +486,53 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("show-validator", help="print this node's validator")
     sp.set_defaults(fn=cmd_show_validator)
+
+    sp = sub.add_parser("light", help="run a light-client proxy")
+    sp.add_argument("chain_id")
+    sp.add_argument("-p", "--primary", required=True,
+                    help="primary RPC addr")
+    sp.add_argument("-w", "--witnesses", default="",
+                    help="comma-separated witness RPC addrs")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.add_argument("--trusted-height", type=int, default=0)
+    sp.add_argument("--trusted-hash", default="")
+    sp.add_argument("--trust-period", type=float, default=168 * 3600.0,
+                    help="seconds")
+    sp.add_argument("--sequential", action="store_true")
+    sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("replay", help="print the consensus WAL")
+    sp.set_defaults(fn=cmd_replay, console=False)
+
+    sp = sub.add_parser(
+        "replay-console", help="step through the consensus WAL"
+    )
+    sp.set_defaults(fn=cmd_replay, console=True)
+
+    sp = sub.add_parser("rewind", help="rewind state+blocks to a height")
+    sp.add_argument("--height", type=int, required=True)
+    sp.set_defaults(fn=cmd_rewind)
+
+    sp = sub.add_parser("compact", help="compact the sqlite stores")
+    sp.set_defaults(fn=cmd_compact)
+
+    sp = sub.add_parser(
+        "reindex-event", help="rebuild the tx/block event index"
+    )
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
+
+    sp = sub.add_parser("debug", help="debug utilities")
+    dsub = sp.add_subparsers(dest="debug_cmd", required=True)
+    dp = dsub.add_parser("dump", help="snapshot node state to a dir")
+    dp.add_argument("--rpc-laddr", default="tcp://127.0.0.1:26657")
+    dp.add_argument("--pprof-laddr", default="")
+    dp.add_argument("--output", default="./debug-dump")
+    dp.set_defaults(fn=cmd_debug_dump)
+
+    sp = sub.add_parser("probe-upnp", help="probe for a UPnP gateway")
+    sp.set_defaults(fn=cmd_probe_upnp)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

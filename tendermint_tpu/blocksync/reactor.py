@@ -81,6 +81,11 @@ class BlocksyncReactor(Reactor):
         # windowed batch verify is suspended below this height after a
         # batch failure (the per-block path must get past it first)
         self._window_suspended_below = 0
+        # adaptive batch width: shrinks toward the observed rotation-free
+        # run length (validator updates invalidate window verdicts), grows
+        # back on full-window success — a chain rotating every height
+        # converges to ~per-block work instead of O(window^2) re-verifies
+        self._window_limit = self.VERIFY_WINDOW
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [
@@ -218,8 +223,10 @@ class BlocksyncReactor(Reactor):
         """
         while True:
             window = (
-                self.pool.peek_window(self.VERIFY_WINDOW)
-                if self.pool.height > self._window_suspended_below
+                self.pool.peek_window(
+                    min(self.VERIFY_WINDOW, self._window_limit)
+                )
+                if self.pool.height >= self._window_suspended_below
                 else []
             )
             if len(window) > 1:
@@ -249,8 +256,11 @@ class BlocksyncReactor(Reactor):
                     )
                 # apply the verified prefix; verdicts are only valid while
                 # the validator set is unchanged from the window base
+                applied = 0
+                rotated = False
                 for i in range(n_ok):
                     if self.state.validators.hash() != base_hash:
+                        rotated = True
                         break  # rotation: re-verify the rest next pass
                     first, fid, parts, commit = prepared[i]
                     try:
@@ -267,6 +277,15 @@ class BlocksyncReactor(Reactor):
                         return
                     await self._apply_synced_block(
                         first, fid, parts, commit, bls_datas
+                    )
+                    applied += 1
+                if rotated:
+                    # next window ~ the rotation-free run just observed
+                    # (floor 2 keeps the windowed path probing cheaply)
+                    self._window_limit = max(2, applied)
+                elif applied == len(window):
+                    self._window_limit = min(
+                        self.VERIFY_WINDOW, self._window_limit * 2
                     )
                 if n_ok == len(window) and n_ok > 0:
                     continue

@@ -178,11 +178,35 @@ def aggregate_public_keys(pubs: list[PublicKey]) -> PublicKey:
     return new_trusted_public_key(acc)
 
 
+# host->device switchover for signature aggregation: below this the
+# serial host loop beats the device round-trip; above it the tree
+# reduction in ops/bls_g1.py wins (the N-proportional part of
+# AggregateSignatures, bls_signatures.go:138-149)
+DEVICE_AGGREGATE_MIN = 64
+
+
 def aggregate_signatures(sigs: list):
+    if len(sigs) >= DEVICE_AGGREGATE_MIN:
+        try:
+            return aggregate_signatures_device(sigs)
+        except Exception:  # no usable backend: the host loop is exact
+            pass
     acc = c.G1_INF
     for s in sigs:
         acc = c.g1_add(acc, s)
     return acc
+
+
+def aggregate_signatures_device(sigs: list):
+    """Sum N G1 signatures as a log2(N)-level device tree reduction."""
+    import numpy as np
+
+    from ..ops import bls_g1 as dev
+
+    pts = np.stack([dev.g1_from_host(s) for s in sigs])
+    import jax.numpy as jnp
+
+    return dev.g1_to_host(dev.g1_aggregate_jit(jnp.asarray(pts)))
 
 
 def verify_aggregated_same_message(sig, message: bytes, pubs: list[PublicKey]) -> bool:

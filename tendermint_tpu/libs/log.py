@@ -24,7 +24,9 @@ class Logger:
         fmt: str = "plain",
         fields: Optional[dict] = None,
     ):
-        self._sink = sink if sink is not None else sys.stderr
+        # None = resolve sys.stderr at emit time (a bound stream may be
+        # closed later, e.g. pytest's per-test capture)
+        self._sink = sink
         self._level = LEVELS.get(level, 20)
         self._fmt = fmt
         self._fields = fields or {}
@@ -44,12 +46,16 @@ class Logger:
             k: (v() if callable(v) else v) for k, v in record.items()
         }
         ts = time.strftime("%H:%M:%S", time.localtime())
-        if self._fmt == "json":
-            record = {"ts": ts, "level": level, "msg": msg, **record}
-            self._sink.write(json.dumps(record, default=str) + "\n")
-        else:
-            kvs = " ".join(f"{k}={v}" for k, v in record.items())
-            self._sink.write(f"{level[0].upper()}[{ts}] {msg} {kvs}\n")
+        sink = self._sink if self._sink is not None else sys.stderr
+        try:
+            if self._fmt == "json":
+                record = {"ts": ts, "level": level, "msg": msg, **record}
+                sink.write(json.dumps(record, default=str) + "\n")
+            else:
+                kvs = " ".join(f"{k}={v}" for k, v in record.items())
+                sink.write(f"{level[0].upper()}[{ts}] {msg} {kvs}\n")
+        except ValueError:
+            pass  # sink closed (interpreter/test teardown): drop the line
 
     def debug(self, msg: str, **fields: Any) -> None:
         self._emit("debug", msg, fields)

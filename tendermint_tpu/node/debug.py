@@ -1,0 +1,158 @@
+"""Profiling/debug HTTP server (reference node/node.go:969-975 pprof).
+
+The reference mounts Go's net/http/pprof on `config.RPC.PprofListenAddress`.
+The equivalents here, one GET route each:
+
+- `/debug/pprof/profile?seconds=N` — cProfile the event-loop thread for N
+  seconds, return pstats text (pprof CPU profile analog).
+- `/debug/pprof/goroutine`        — every thread stack + asyncio task
+  stack (goroutine dump analog; pairs with libs.sync's watchdog).
+- `/debug/pprof/heap`             — tracemalloc top allocations.
+- `/debug/jax/trace?seconds=N`    — capture a JAX profiler trace (the
+  device-plane profiler the reference has no counterpart for) into
+  `<home>/traces/`, return the path; view with tensorboard/xprof.
+
+`tendermint_tpu debug dump` (cmd/tendermint/commands/debug in the
+reference) snapshots all of these plus `/status` into a directory.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import cProfile
+import io
+import pstats
+import sys
+import time
+import traceback
+from typing import Optional
+
+from ..libs.service import Service
+
+
+def thread_and_task_dump() -> str:
+    from ..libs.sync import dump_all_stacks
+
+    out = io.StringIO()
+    out.write(dump_all_stacks())
+    out.write("\n")
+    try:
+        for task in asyncio.all_tasks():
+            out.write(f"--- task {task.get_name()} ---\n")
+            for f in task.get_stack(limit=20):
+                traceback.print_stack(f, limit=1, file=out)
+    except RuntimeError:
+        pass
+    return out.getvalue()
+
+
+class DebugServer(Service):
+    def __init__(self, host: str, port: int, trace_dir: str = "/tmp"):
+        super().__init__("debug")
+        self.host = host
+        self.port = port
+        self.trace_dir = trace_dir
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def on_start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.logger.info("pprof listening", addr=f"{self.host}:{self.port}")
+
+    async def on_stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            req = await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass
+            parts = req.decode().split(" ")
+            target = parts[1] if len(parts) > 1 else "/"
+            path, _, query = target.partition("?")
+            params = {}
+            for kv in query.split("&"):
+                k, _, v = kv.partition("=")
+                if k:
+                    params[k] = v
+            body, ctype = await self._route(path, params)
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: " + ctype.encode()
+                + b"\r\nContent-Length: " + str(len(body)).encode()
+                + b"\r\nConnection: close\r\n\r\n" + body
+            )
+            await writer.drain()
+        except Exception as e:  # debug surface: report, never crash the node
+            try:
+                msg = str(e).encode()
+                writer.write(
+                    b"HTTP/1.1 500 Internal\r\nContent-Length: "
+                    + str(len(msg)).encode() + b"\r\n\r\n" + msg
+                )
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            writer.close()
+
+    async def _route(self, path: str, params: dict) -> tuple[bytes, str]:
+        if path == "/debug/pprof/goroutine":
+            return thread_and_task_dump().encode(), "text/plain"
+        if path == "/debug/pprof/heap":
+            return (await self._heap()).encode(), "text/plain"
+        if path == "/debug/pprof/profile":
+            secs = min(float(params.get("seconds", 1)), 60.0)
+            return (await self._profile(secs)).encode(), "text/plain"
+        if path == "/debug/jax/trace":
+            secs = min(float(params.get("seconds", 1)), 60.0)
+            return (await self._jax_trace(secs)).encode(), "text/plain"
+        if path in ("/", "/debug/pprof"):
+            return (
+                b"routes: /debug/pprof/{profile,goroutine,heap}, "
+                b"/debug/jax/trace",
+                "text/plain",
+            )
+        raise ValueError(f"unknown debug route {path!r}")
+
+    @staticmethod
+    async def _heap() -> str:
+        import tracemalloc
+
+        started_here = not tracemalloc.is_tracing()
+        if started_here:
+            tracemalloc.start()
+            await asyncio.sleep(0.1)  # let allocations accrue; non-blocking
+        snap = tracemalloc.take_snapshot()
+        if started_here:
+            # don't leave per-allocation tracing overhead on a live node
+            tracemalloc.stop()
+        stats = snap.statistics("lineno")[:50]
+        return "\n".join(str(s) for s in stats)
+
+    @staticmethod
+    async def _profile(secs: float) -> str:
+        """Profile the loop thread: cProfile can't attach to a running
+        loop from outside, so sample by running the profiler around a
+        sleep ON the loop — captures everything the loop executes."""
+        prof = cProfile.Profile()
+        prof.enable()
+        await asyncio.sleep(secs)
+        prof.disable()
+        s = io.StringIO()
+        pstats.Stats(prof, stream=s).sort_stats("cumulative").print_stats(60)
+        return s.getvalue()
+
+    async def _jax_trace(self, secs: float) -> str:
+        import os
+
+        import jax
+
+        path = os.path.join(self.trace_dir, f"jax-trace-{int(time.time())}")
+        jax.profiler.start_trace(path)
+        await asyncio.sleep(secs)
+        jax.profiler.stop_trace()
+        return path

@@ -263,6 +263,7 @@ class Node(Service):
         # --- rpc + metrics ---
         self.rpc_server = None
         self.metrics_server = None
+        self.debug_server = None
 
     # --- helpers ------------------------------------------------------------
 
@@ -324,6 +325,17 @@ class Node(Service):
             host, port = self._parse_laddr(self.config.rpc.laddr)
             self.rpc_server = RPCServer(self, host, port)
             await self.rpc_server.start()
+        # pprof/debug (reference node.go:969-975)
+        if self.config.rpc.pprof_laddr:
+            from .debug import DebugServer
+
+            host, port = self._parse_laddr(self.config.rpc.pprof_laddr)
+            self.debug_server = DebugServer(
+                host or "127.0.0.1",
+                port,
+                trace_dir=os.path.join(self.config.root_dir, "traces"),
+            )
+            await self.debug_server.start()
         # metrics
         if self.config.instrumentation.prometheus:
             from ..libs.metrics import MetricsServer
@@ -433,6 +445,8 @@ class Node(Service):
             await self.rpc_server.stop()
         if self.metrics_server is not None:
             await self.metrics_server.stop()
+        if self.debug_server is not None:
+            await self.debug_server.stop()
         if self.indexer_service is not None:
             await self.indexer_service.stop()
         await self.proxy_app.stop()

@@ -1,0 +1,332 @@
+"""Batched BLS12-381 G1 arithmetic on TPU — the aggregation kernel.
+
+SURVEY.md §2.2 row "BLS12-381 pairing / aggregate verify": the host
+scheme (crypto/bls_signatures.py, ref blssignatures/bls_signatures.go:
+129-149) aggregates N signatures with N-1 G1 point additions. This
+kernel does the additions as a device tree reduction: [B, 3, 48]
+Jacobian points halve per level, log2(B) batched levels instead of a
+serial host loop. Pairings stay on host (2 per aggregate verify,
+independent of N) — the N-proportional work is exactly this kernel.
+
+Design mirrors ops/field25519.py: radix-2^8 limbs (48 for the 381-bit
+prime) in int32 lanes, loose invariant limbs < 2^9, carry passes with a
+vector wrap (2^384 ≡ F0 (mod p) is a 48-limb constant, not a scalar —
+the wrap is carry × F0 instead of carry × 38). Every control decision
+(infinity, doubling, opposite points) is a mask — one straight-line XLA
+program, `vmap`/`shard_map`-tileable like the ed25519 kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+NLIMBS = 48
+
+
+def _limbs_of(x: int, n: int = NLIMBS) -> np.ndarray:
+    return np.array([int(b) for b in x.to_bytes(n, "little")], dtype=np.int32)
+
+
+P_LIMBS = _limbs_of(P)
+# fold table: F[i] = 2^(8*(48+i)) mod p, for folding conv columns >= 48
+_F_FOLD = np.stack([_limbs_of(pow(2, 8 * (48 + i), P)) for i in range(NLIMBS + 2)])
+_F0 = _F_FOLD[0]
+# additive bias ≡ 0 (mod p) with all limbs >= 2048 — keeps `sub` limb
+# differences non-negative for loose (< 2^11) subtrahends. 128p
+# (~2^387.7) decomposed non-canonically with limbs in [2048, 4095+],
+# leftover 2^384-weight digits folded through F0.
+_BIAS_INT = 128 * P
+
+
+def _bias_limbs() -> np.ndarray:
+    """Non-canonical digits of 128p with limbs 0..47 in [2048, 2303]:
+    write 128p = 2048·(2^384-1)/255 + REM and give every low limb its
+    2048 floor plus REM's ordinary base-256 digit (< 256)."""
+    floor_sum = 2048 * ((1 << 384) - 1) // 255  # value of all-2048 limbs
+    rem = _BIAS_INT - floor_sum
+    assert rem >= 0
+    out = np.zeros(NLIMBS + 1, dtype=np.int64)
+    out[NLIMBS] = rem >> 384
+    rem &= (1 << 384) - 1
+    digits = rem.to_bytes(NLIMBS, "little")
+    for i in range(NLIMBS):
+        out[i] = 2048 + digits[i]
+    assert all(2048 <= int(x) <= 2303 for x in out[:NLIMBS])
+    return out
+
+
+_BIAS_RAW = _bias_limbs()
+_BIAS_TOP = int(_BIAS_RAW[NLIMBS])
+_BIAS = (_BIAS_RAW[:NLIMBS] + _BIAS_TOP * _F0.astype(np.int64)).astype(
+    np.int32
+)
+assert (
+    sum(int(v) << (8 * i) for i, v in enumerate(_BIAS)) % P == 0
+), "bias must be ≡ 0 mod p"
+# the 2-pass bound in sub()/neg() needs bias limbs < 2^14: then
+# a + bias - b < 2^14.2, pass 1 leaves < 2^14.4, pass 2 < 2^11.
+assert _BIAS.max() < (1 << 14), "sub()'s 2-pass carry bound needs this"
+
+
+def from_int(x: int) -> np.ndarray:
+    return _limbs_of(x % P)
+
+
+def to_int(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.int64)
+    return int(sum(int(v) << (8 * i) for i, v in enumerate(arr.tolist())))
+
+
+def zeros(shape=()) -> jnp.ndarray:
+    return jnp.zeros((*shape, NLIMBS), dtype=jnp.int32)
+
+
+def ones(shape=()) -> jnp.ndarray:
+    z = np.zeros((*shape, NLIMBS), dtype=np.int32)
+    z[..., 0] = 1
+    return jnp.asarray(z)
+
+
+def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry pass; the top carry wraps via F0 (2^384 mod p).
+
+    Unlike field25519's scalar-38 wrap (Crandall prime), F0 is a full
+    48-limb vector, so a big top carry re-injects big values into every
+    limb and convergence is ~3 bits of top-carry per pass (F0's own top
+    limb is < 32). The loose invariant here is therefore limbs < 2^11
+    (conv stays int32-safe: 48 products of < 2^22 -> < 2^27.6), reached
+    after the pass counts used below — bounds pinned empirically by
+    tests/test_ops_bls_g1.py's worst-case stress."""
+    c = x >> 8
+    r = x - (c << 8)
+    wrap = jnp.concatenate(
+        [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1
+    )
+    return r + wrap + c[..., -1:] * jnp.asarray(_F0)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    x = a + jnp.asarray(_BIAS) - b
+    x = _carry_pass(x)
+    return _carry_pass(x)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    x = jnp.asarray(_BIAS) - a
+    x = _carry_pass(x)
+    return _carry_pass(x)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """48x48 limb convolution, fold by the 2^384-mod-p table, carry.
+
+    Columns: 48-term sums of < 2^18 products -> < 2^23.6 (int32-safe).
+    The fold normalizes hi columns to bytes first (scan), then one
+    [.., 48+2] @ F matmul brings everything under 48 limbs."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)[:-1]
+    out = jnp.zeros((*shape, 2 * NLIMBS - 1), dtype=jnp.int32)
+    for i in range(NLIMBS):
+        out = out.at[..., i : i + NLIMBS].add(a[..., i : i + 1] * b)
+    # exact scan-carry the full 95 columns -> strict bytes + 2 top limbs
+    limbs, top = _scan_carry(out)  # top < 2^16
+    t_lo = top & 255
+    t_hi = top >> 8
+    hi_bytes = jnp.concatenate(
+        [limbs[..., NLIMBS:], t_lo[..., None], t_hi[..., None]], axis=-1
+    )  # [..., 49]: conv cols 48..94 (weights F[0..46]) + carry bytes
+    # of col 94's scan-out (weights F[47], F[48])
+    folded = limbs[..., :NLIMBS] + jnp.matmul(
+        hi_bytes, jnp.asarray(_F_FOLD[: NLIMBS + 1])
+    )
+    x = folded  # cols < 256 + 50*2^16 < 2^22.7
+    for _ in range(5):
+        x = _carry_pass(x)
+    return x
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    assert 0 <= k <= 1 << 14
+    x = a * k
+    x = _carry_pass(x)
+    x = _carry_pass(x)
+    return _carry_pass(x)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(cond[..., None], a, b)
+
+
+def _scan_carry(x: jnp.ndarray):
+    xt = jnp.moveaxis(x, -1, 0)
+
+    def step(carry, limb):
+        v = limb + carry
+        c = v >> 8
+        return c, v - (c << 8)
+
+    top, limbs = jax.lax.scan(step, jnp.zeros_like(xt[0]), xt)
+    return jnp.moveaxis(limbs, 0, -1), top
+
+
+# floor(2^392 / p): quotient estimator for the final subtraction —
+# q ≈ (top 16 bits of value) * _MU >> 24 underestimates value//p by <= 2.
+_MU = (1 << 392) // P
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Loose -> canonical limbs in [0, p)."""
+    limbs, top = _scan_carry(x)
+    # fold 2^384-weight carries until gone (top < 2^4 for loose input;
+    # each fold multiplies the excess by ~2^-3)
+    for _ in range(4):
+        limbs = limbs + top[..., None] * jnp.asarray(_F0)
+        limbs, top = _scan_carry(limbs)
+    # value < 2^384 now (~13.9 p): estimate q = value // p from the top
+    # 16 bits, subtract q*p, then at most 2 conditional subtracts.
+    p_l = jnp.asarray(P_LIMBS)
+    t16 = (limbs[..., 47] << 8) | limbs[..., 46]
+    q = jnp.maximum((t16 * _MU) >> 24, 0)
+    limbs, _ = _scan_carry(limbs - q[..., None] * p_l)
+    for _ in range(3):
+        diff = limbs - p_l
+        nz = diff != 0
+        idx = (NLIMBS - 1) - jnp.argmax(nz[..., ::-1], axis=-1)
+        ms = jnp.take_along_axis(diff, idx[..., None], axis=-1)[..., 0]
+        geq = jnp.where(jnp.any(nz, axis=-1), ms > 0, True)
+        limbs = limbs - p_l * geq[..., None].astype(jnp.int32)
+        limbs, _ = _scan_carry(limbs)
+    return limbs
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+# --- G1 (Jacobian) ---------------------------------------------------------
+#
+# point: [..., 3, 48] (X, Y, Z); infinity = Z == 0. Formulas match the
+# host oracle (crypto/bls12_381.py g1_add/g1_double: dbl-2009-l and
+# add-2007-bl shapes) so device results equal host results limb-wise
+# after canonicalization.
+
+
+def g1_identity(shape=()) -> jnp.ndarray:
+    z = np.zeros((*shape, 3, NLIMBS), dtype=np.int32)
+    z[..., 1, 0] = 1  # (0, 1, 0)
+    return jnp.asarray(z)
+
+
+def g1_from_host(p) -> np.ndarray:
+    return np.stack([from_int(c) for c in p])
+
+
+def g1_to_host(pt) -> tuple:
+    arr = np.asarray(canonical_jit(jnp.asarray(pt)))
+    return tuple(to_int(arr[i]) for i in range(3))
+
+
+def g1_is_inf(p: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(p[..., 2, :])
+
+
+def g1_double(p: jnp.ndarray) -> jnp.ndarray:
+    x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    a = sqr(x)
+    b = sqr(y)
+    c = sqr(b)
+    xb = add(x, b)
+    d = mul_small(sub(sub(sqr(xb), a), c), 2)
+    e = mul_small(a, 3)
+    f = sqr(e)
+    x3 = sub(f, mul_small(d, 2))
+    y3 = sub(mul(e, sub(d, x3)), mul_small(c, 8))
+    z3 = mul_small(mul(y, z), 2)
+    # y == 0 (order-2 would-be point; not on G1 but stay branch-free and
+    # match the host: result = identity)
+    bad = is_zero(y) | is_zero(z)
+    out = jnp.stack([x3, y3, z3], axis=-2)
+    return jnp.where(bad[..., None, None], g1_identity(x.shape[:-1]), out)
+
+
+def g1_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Branch-free complete addition: handles inf, equal, and opposite
+    inputs via masks (host oracle: crypto/bls12_381.py g1_add)."""
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    x2, y2, z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+    z1z1 = sqr(z1)
+    z2z2 = sqr(z2)
+    u1 = mul(x1, z2z2)
+    u2 = mul(x2, z1z1)
+    s1 = mul(mul(y1, z2), z2z2)
+    s2 = mul(mul(y2, z1), z1z1)
+    h = sub(u2, u1)
+    same_x = is_zero(h)
+    r2 = sub(s2, s1)
+    same_y = is_zero(r2)
+    h2 = mul_small(h, 2)
+    i = sqr(h2)
+    j = mul(h, i)
+    rr = mul_small(r2, 2)
+    v = mul(u1, i)
+    x3 = sub(sub(sqr(rr), j), mul_small(v, 2))
+    y3 = sub(mul(rr, sub(v, x3)), mul_small(mul(s1, j), 2))
+    z3 = mul(sub(sub(sqr(add(z1, z2)), z1z1), z2z2), h)
+    added = jnp.stack([x3, y3, z3], axis=-2)
+
+    doubled = g1_double(p)
+    p_inf = is_zero(z1)
+    q_inf = is_zero(z2)
+    # precedence: p inf -> q; q inf -> p; same x and y -> double;
+    # same x, opposite y -> identity; else -> added
+    out = added
+    ident = g1_identity(x1.shape[:-1])
+    out = jnp.where((same_x & ~same_y)[..., None, None], ident, out)
+    out = jnp.where((same_x & same_y)[..., None, None], doubled, out)
+    out = jnp.where(q_inf[..., None, None], p, out)
+    out = jnp.where(p_inf[..., None, None], q, out)
+    return out
+
+
+g1_add_jit = jax.jit(g1_add)
+
+
+def g1_aggregate(points: jnp.ndarray) -> jnp.ndarray:
+    """Tree-reduce [B, 3, 48] -> [3, 48]: sum of all points in log2(B)
+    batched add levels (the device form of AggregateSignatures'
+    point-add loop, bls_signatures.go:138-149). B padded to a power of
+    two with identity. Each level reuses the ONE jitted g1_add (per
+    level shape) rather than inlining the whole tree into a single
+    program — a 128-leaf tree would otherwise trace ~2000 field muls
+    into one giant compile."""
+    b = points.shape[0]
+    nb = 1 << max(1, (b - 1).bit_length())
+    if nb != b:
+        pad = jnp.broadcast_to(
+            g1_identity(), (nb - b, 3, NLIMBS)
+        ).astype(points.dtype)
+        points = jnp.concatenate([points, pad], axis=0)
+    while points.shape[0] > 1:
+        points = g1_add_jit(points[0::2], points[1::2])
+    return points[0]
+
+
+g1_aggregate_jit = g1_aggregate  # levels are jitted internally
+g1_double_jit = jax.jit(g1_double)
+mul_jit = jax.jit(mul)
+canonical_jit = jax.jit(canonical)

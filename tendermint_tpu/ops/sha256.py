@@ -1,0 +1,196 @@
+"""Batched SHA-256 on TPU (merkle leaf/inner hashing).
+
+SURVEY.md §2.2 row "SHA-256 / tmhash": the reference leans on stdlib
+SHA-NI assembly (crypto/merkle/hash.go); bulk workloads here (hashing
+thousands of merkle leaves / tx hashes per block) run as one fixed-shape
+XLA program over uint32 lanes instead of a host loop.
+
+Layout: messages are host-prepadded (`pad_messages`) into [B, NBLK*64]
+buffers + a per-row active-block count. The kernel runs the compression
+function over all NBLK blocks with a masked state update, so rows whose
+message ended early keep their digest — ragged batches in one static
+shape. Padded-length buckets keep NBLK small (one bucket per power of
+two of blocks in practice).
+
+`merkle_leaf_hash` / `merkle_inner_hash` mirror crypto/merkle.py's
+RFC 6962 domain separation (leaf 0x00 / inner 0x01) so a device-built
+tree equals the host tree byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5,
+        0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+        0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+        0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC,
+        0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7,
+        0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+        0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+        0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3,
+        0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5,
+        0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+        0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_H0 = np.array(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress(state, block_words):
+    """state: [..., 8] u32; block_words: [..., 16] u32 -> [..., 8] u32."""
+    # message schedule
+    w = [block_words[..., i] for i in range(16)]
+    for i in range(16, 64):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
+    k = jnp.asarray(_K)
+    for i in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k[i] + w[i]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    new = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+    return state + new
+
+
+def _bytes_to_words(blocks_u8):
+    """[..., N*4] u8 big-endian -> [..., N] u32."""
+    b = blocks_u8.astype(jnp.uint32)
+    shp = b.shape[:-1] + (b.shape[-1] // 4, 4)
+    b = b.reshape(shp)
+    return (
+        (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+    )
+
+
+def _words_to_bytes(words):
+    """[..., N] u32 -> [..., N*4] u8 big-endian."""
+    w = words[..., None]
+    out = jnp.concatenate(
+        [(w >> 24), (w >> 16), (w >> 8), w], axis=-1
+    ) & jnp.uint32(0xFF)
+    return out.reshape(*words.shape[:-1], words.shape[-1] * 4).astype(
+        jnp.uint8
+    )
+
+
+def sha256_batch(data: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarray:
+    """data: [B, NBLK*64] u8 prepadded; n_blocks: [B] int32 (>=1).
+    Returns [B, 32] u8 digests."""
+    nblk = data.shape[-1] // 64
+    words = _bytes_to_words(data)  # [B, NBLK*16]
+    state = jnp.broadcast_to(
+        jnp.asarray(_H0), (*data.shape[:-1], 8)
+    ).astype(jnp.uint32)
+
+    def body(i, st):
+        blk = jax.lax.dynamic_slice_in_dim(words, i * 16, 16, axis=-1)
+        new = _compress(st, blk)
+        active = (i < n_blocks)[..., None]
+        return jnp.where(active, new, st)
+
+    state = jax.lax.fori_loop(0, nblk, body, state)
+    return _words_to_bytes(state)
+
+
+def pad_messages(msgs: list[bytes], prefix: bytes = b"") -> tuple:
+    """Host helper: SHA-256 pad `prefix+m` for each m into one fixed
+    [B, NBLK*64] buffer + [B] block counts."""
+    lens = [len(prefix) + len(m) for m in msgs]
+    nblk = max(1, max((l + 9 + 63) // 64 for l in lens))
+    buf = np.zeros((len(msgs), nblk * 64), dtype=np.uint8)
+    counts = np.zeros(len(msgs), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        full = prefix + m
+        l = len(full)
+        buf[i, :l] = np.frombuffer(full, dtype=np.uint8)
+        buf[i, l] = 0x80
+        bits = l * 8
+        blocks = (l + 9 + 63) // 64
+        buf[i, blocks * 64 - 8 : blocks * 64] = np.frombuffer(
+            bits.to_bytes(8, "big"), dtype=np.uint8
+        )
+        counts[i] = blocks
+    return buf, counts
+
+
+sha256_batch_jit = jax.jit(sha256_batch)
+
+
+# --- RFC 6962 merkle on device --------------------------------------------
+
+
+def merkle_leaf_hash(leaves: jnp.ndarray) -> jnp.ndarray:
+    """[B, N] u8 fixed-size leaves -> [B, 32] u8 SHA-256(0x00 || leaf).
+    (crypto/merkle.py leaf rule; one block as long as N <= 54.)"""
+    b, n = leaves.shape
+    total = 1 + n
+    assert total + 9 <= 64, "fixed-size device path: leaf must fit a block"
+    buf = jnp.zeros((b, 64), dtype=jnp.uint8)
+    buf = buf.at[:, 0].set(0)
+    buf = buf.at[:, 1 : 1 + n].set(leaves)
+    buf = buf.at[:, total].set(0x80)
+    bits = total * 8
+    buf = buf.at[:, 56:64].set(
+        jnp.asarray(
+            np.frombuffer(bits.to_bytes(8, "big"), dtype=np.uint8)
+        )
+    )
+    return sha256_batch(buf, jnp.ones(b, dtype=jnp.int32))
+
+
+def merkle_inner_hash(left: jnp.ndarray, right: jnp.ndarray) -> jnp.ndarray:
+    """[B, 32] x [B, 32] -> [B, 32] SHA-256(0x01 || l || r) (2 blocks)."""
+    b = left.shape[0]
+    buf = jnp.zeros((b, 128), dtype=jnp.uint8)
+    buf = buf.at[:, 0].set(1)
+    buf = buf.at[:, 1:33].set(left)
+    buf = buf.at[:, 33:65].set(right)
+    buf = buf.at[:, 65].set(0x80)
+    bits = 65 * 8
+    buf = buf.at[:, 120:128].set(
+        jnp.asarray(
+            np.frombuffer(bits.to_bytes(8, "big"), dtype=np.uint8)
+        )
+    )
+    return sha256_batch(buf, jnp.full(b, 2, dtype=jnp.int32))
+
+
+def merkle_root_pow2(leaves: jnp.ndarray) -> jnp.ndarray:
+    """Full RFC 6962 tree for a power-of-two batch of fixed-size leaves:
+    [B, N] u8 -> [32] u8 root. Level-by-level device folds (the
+    unbalanced general case stays host-side in crypto/merkle.py)."""
+    b = leaves.shape[0]
+    assert b & (b - 1) == 0, "device tree fold requires power-of-two leaves"
+    level = merkle_leaf_hash(leaves)
+    while level.shape[0] > 1:
+        level = merkle_inner_hash(level[0::2], level[1::2])
+    return level[0]

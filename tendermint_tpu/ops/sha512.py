@@ -1,0 +1,293 @@
+"""Batched SHA-512 on TPU + the ed25519 challenge reduction mod L.
+
+SURVEY.md §2.2 row "SHA-512": the per-signature challenge
+k = SHA-512(R || A || M) mod L was the last host-side per-item loop in
+the verify pipeline (ops/ed25519_batch.py takes prehashed k). This
+kernel computes it on device: TPUs have no 64-bit lanes, so a 64-bit
+word is an (hi, lo) uint32 pair; rotations/shifts are static-index
+pair shuffles and additions carry via unsigned compare.
+
+Same ragged-batch convention as ops/sha256.py: host-prepadded
+[B, NBLK*128] buffers + per-row block counts, masked state updates.
+
+`challenge_batch` = SHA-512 + exact reduction mod L (canonical — the
+cofactorless check must use k mod L bit-for-bit like the host oracle
+crypto/ed25519.py:challenge; a k' ≡ k (mod L) but > L would diverge on
+adversarial keys with small-order components).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+L = (1 << 252) + 27742317777372353535851937790883648493
+
+_K64 = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F,
+    0xE9B5DBA58189DBBC, 0x3956C25BF348B538, 0x59F111F1B605D019,
+    0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118, 0xD807AA98A3030242,
+    0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235,
+    0xC19BF174CF692694, 0xE49B69C19EF14AD2, 0xEFBE4786384F25E3,
+    0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65, 0x2DE92C6F592B0275,
+    0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F,
+    0xBF597FC7BEEF0EE4, 0xC6E00BF33DA88FC2, 0xD5A79147930AA725,
+    0x06CA6351E003826F, 0x142929670A0E6E70, 0x27B70A8546D22FFC,
+    0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6,
+    0x92722C851482353B, 0xA2BFE8A14CF10364, 0xA81A664BBC423001,
+    0xC24B8B70D0F89791, 0xC76C51A30654BE30, 0xD192E819D6EF5218,
+    0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99,
+    0x34B0BCB5E19B48A8, 0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB,
+    0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3, 0x748F82EE5DEFB2FC,
+    0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915,
+    0xC67178F2E372532B, 0xCA273ECEEA26619C, 0xD186B8C721C0C207,
+    0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178, 0x06F067AA72176FBA,
+    0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC,
+    0x431D67C49C100D4C, 0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A,
+    0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+_KH = np.array([k >> 32 for k in _K64], dtype=np.uint32)
+_KL = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)
+
+_H0_64 = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B,
+    0xA54FF53A5F1D36F1, 0x510E527FADE682D1, 0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+_H0H = np.array([h >> 32 for h in _H0_64], dtype=np.uint32)
+_H0L = np.array([h & 0xFFFFFFFF for h in _H0_64], dtype=np.uint32)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _rotr64(h, l, n: int):
+    if n == 0:
+        return h, l
+    if n < 32:
+        return (h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n))
+    if n == 32:
+        return l, h
+    m = n - 32
+    return (l >> m) | (h << (32 - m)), (h >> m) | (l << (32 - m))
+
+
+def _shr64(h, l, n: int):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _xor3(a, b, c):
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _compress512(sh, sl, wh, wl):
+    """One SHA-512 compression. sh/sl: [..., 8]; wh/wl: [..., 16]."""
+    whs = [wh[..., i] for i in range(16)]
+    wls = [wl[..., i] for i in range(16)]
+    for i in range(16, 80):
+        s0 = _xor3(
+            _rotr64(whs[i - 15], wls[i - 15], 1),
+            _rotr64(whs[i - 15], wls[i - 15], 8),
+            _shr64(whs[i - 15], wls[i - 15], 7),
+        )
+        s1 = _xor3(
+            _rotr64(whs[i - 2], wls[i - 2], 19),
+            _rotr64(whs[i - 2], wls[i - 2], 61),
+            _shr64(whs[i - 2], wls[i - 2], 6),
+        )
+        h, l = _add64(whs[i - 16], wls[i - 16], s0[0], s0[1])
+        h, l = _add64(h, l, whs[i - 7], wls[i - 7])
+        h, l = _add64(h, l, s1[0], s1[1])
+        whs.append(h)
+        wls.append(l)
+
+    regs = [(sh[..., i], sl[..., i]) for i in range(8)]
+    a, b, c, d, e, f, g, hh = regs
+    kh = jnp.asarray(_KH)
+    kl = jnp.asarray(_KL)
+    for i in range(80):
+        s1 = _xor3(
+            _rotr64(*e, 14), _rotr64(*e, 18), _rotr64(*e, 41)
+        )
+        ch = (e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1])
+        t1 = _add64(*hh, *s1)
+        t1 = _add64(*t1, *ch)
+        t1 = _add64(*t1, kh[i], kl[i])
+        t1 = _add64(*t1, whs[i], wls[i])
+        s0 = _xor3(
+            _rotr64(*a, 28), _rotr64(*a, 34), _rotr64(*a, 39)
+        )
+        maj = (
+            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+        )
+        t2 = _add64(*s0, *maj)
+        hh, g, f = g, f, e
+        e = _add64(*d, *t1)
+        d, c, b = c, b, a
+        a = _add64(*t1, *t2)
+    outs = [a, b, c, d, e, f, g, hh]
+    oh = jnp.stack(
+        [_add64(*outs[i], sh[..., i], sl[..., i])[0] for i in range(8)],
+        axis=-1,
+    )
+    ol = jnp.stack(
+        [_add64(*outs[i], sh[..., i], sl[..., i])[1] for i in range(8)],
+        axis=-1,
+    )
+    return oh, ol
+
+
+def _bytes_to_words64(blocks_u8):
+    """[..., N*8] u8 big-endian -> ([..., N] hi u32, [..., N] lo u32)."""
+    b = blocks_u8.astype(jnp.uint32)
+    shp = b.shape[:-1] + (b.shape[-1] // 8, 8)
+    b = b.reshape(shp)
+    hi = (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+    lo = (b[..., 4] << 24) | (b[..., 5] << 16) | (b[..., 6] << 8) | b[..., 7]
+    return hi, lo
+
+
+def sha512_batch(data: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarray:
+    """data: [B, NBLK*128] u8 prepadded; n_blocks: [B] int32.
+    Returns [B, 64] u8 digests (big-endian words, standard encoding)."""
+    nblk = data.shape[-1] // 128
+    wh, wl = _bytes_to_words64(data)  # [B, NBLK*16] each
+    sh = jnp.broadcast_to(
+        jnp.asarray(_H0H), (*data.shape[:-1], 8)
+    ).astype(jnp.uint32)
+    sl = jnp.broadcast_to(
+        jnp.asarray(_H0L), (*data.shape[:-1], 8)
+    ).astype(jnp.uint32)
+
+    def body(i, st):
+        h, l = st
+        bh = jax.lax.dynamic_slice_in_dim(wh, i * 16, 16, axis=-1)
+        bl = jax.lax.dynamic_slice_in_dim(wl, i * 16, 16, axis=-1)
+        nh, nl = _compress512(h, l, bh, bl)
+        active = (i < n_blocks)[..., None]
+        return jnp.where(active, nh, h), jnp.where(active, nl, l)
+
+    sh, sl = jax.lax.fori_loop(0, nblk, body, (sh, sl))
+    # interleave hi/lo back to bytes
+    words = jnp.stack([sh, sl], axis=-1).reshape(*sh.shape[:-1], 16)
+    w = words[..., None]
+    out = jnp.concatenate(
+        [(w >> 24), (w >> 16), (w >> 8), w], axis=-1
+    ) & jnp.uint32(0xFF)
+    return out.reshape(*sh.shape[:-1], 64).astype(jnp.uint8)
+
+
+def pad_messages(msgs: list[bytes], prefix_pairs=None) -> tuple:
+    """Host helper: SHA-512 pad each message into one [B, NBLK*128]
+    buffer + [B] block counts. `prefix_pairs[i]` (optional bytes) is
+    prepended to msgs[i] — the verify path passes R||A per row."""
+    full = [
+        (prefix_pairs[i] if prefix_pairs else b"") + m
+        for i, m in enumerate(msgs)
+    ]
+    lens = [len(f) for f in full]
+    nblk = max(1, max((l + 17 + 127) // 128 for l in lens))
+    buf = np.zeros((len(full), nblk * 128), dtype=np.uint8)
+    counts = np.zeros(len(full), dtype=np.int32)
+    for i, f in enumerate(full):
+        l = len(f)
+        buf[i, :l] = np.frombuffer(f, dtype=np.uint8)
+        buf[i, l] = 0x80
+        blocks = (l + 17 + 127) // 128
+        bits = l * 8
+        buf[i, blocks * 128 - 8 : blocks * 128] = np.frombuffer(
+            bits.to_bytes(8, "big"), dtype=np.uint8
+        )
+        counts[i] = blocks
+    return buf, counts
+
+
+sha512_batch_jit = jax.jit(sha512_batch)
+
+
+# --- reduction mod L -------------------------------------------------------
+
+NLIMBS = 32
+
+
+def _limbs_of(x: int, n: int = NLIMBS) -> np.ndarray:
+    return np.array(
+        [int(b) for b in x.to_bytes(n, "little")], dtype=np.int32
+    )
+
+
+# T[i] = 2^(8*(32+i)) mod L as 32 radix-2^8 limbs — the fold table for
+# bytes 32.. of a little-endian integer.
+_T_FOLD = np.stack(
+    [_limbs_of(pow(2, 8 * (32 + i), L)) for i in range(NLIMBS)]
+)
+_L_LIMBS = _limbs_of(L)
+
+
+def _scan_carry(x):
+    """Exact base-256 carry over the limb axis (signed-safe)."""
+    xt = jnp.moveaxis(x, -1, 0)
+
+    def step(carry, limb):
+        v = limb + carry
+        c = v >> 8
+        return c, v - (c << 8)
+
+    top, limbs = jax.lax.scan(step, jnp.zeros_like(xt[0]), xt)
+    return jnp.moveaxis(limbs, 0, -1), top
+
+
+def reduce_mod_l(digest: jnp.ndarray) -> jnp.ndarray:
+    """[B, 64] u8 SHA-512 digest (little-endian integer, ed25519
+    convention) -> [B, 32] u8 canonical k = digest mod L."""
+    d = digest.astype(jnp.int32)
+    lo, hi = d[..., :NLIMBS], d[..., NLIMBS:]
+    t = jnp.asarray(_T_FOLD)
+    # byte-fold: value(lo) + hi @ T  (products < 2^16, cols < 2^21)
+    acc = lo + jnp.matmul(hi, t)
+    # repeated normalize+fold until the carry out of 2^256 dies. Each
+    # fold shrinks the excess by ~2^-3 (2^256 mod L ≈ 2^253): the worst
+    # case 2^270 walks 267.3 → 264.6 → … → <2^256.5 in 5 rounds; rounds
+    # 6-8 settle the top∈{0,1} boundary (a 1-carry fold lands < 2^254).
+    for _ in range(8):
+        limbs, top = _scan_carry(acc)  # top = value >> 256
+        acc = limbs + top[..., None] * t[0][None, :]
+    limbs, top = _scan_carry(acc)
+    # top == 0 now (bound chain above); final exact reduction: q = (top
+    # nibble) - 1 cautious estimate, then one conditional subtract.
+    t_nib = limbs[..., 31] >> 4
+    q = jnp.maximum(t_nib - 1, 0)
+    l_l = jnp.asarray(_L_LIMBS)
+    limbs, _ = _scan_carry(limbs - q[..., None] * l_l[None, :])
+    # if still >= L subtract once more (big-endian compare)
+    diff = limbs - l_l
+    nz = diff != 0
+    idx = (NLIMBS - 1) - jnp.argmax(nz[..., ::-1], axis=-1)
+    ms = jnp.take_along_axis(diff, idx[..., None], axis=-1)[..., 0]
+    geq = jnp.where(jnp.any(nz, axis=-1), ms > 0, True)
+    limbs, _ = _scan_carry(limbs - geq[..., None] * l_l[None, :])
+    return limbs.astype(jnp.uint8)
+
+
+def challenge_batch(data: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarray:
+    """Fused device challenge: prepadded R||A||M buffers -> [B, 32]
+    canonical k = SHA-512(R||A||M) mod L (little-endian bytes), ready
+    for ops.ed25519_batch's k_bytes input."""
+    return reduce_mod_l(sha512_batch(data, n_blocks))
+
+
+challenge_batch_jit = jax.jit(challenge_batch)

@@ -393,6 +393,8 @@ class RPCCore:
             "height": h.height,
             "time": h.time_ns,
             "last_block_id": self._bid_json(h.last_block_id),
+            "last_commit_hash": _hex(h.last_commit_hash),
+            "data_hash": _hex(h.data_hash),
             "validators_hash": _hex(h.validators_hash),
             "next_validators_hash": _hex(h.next_validators_hash),
             "consensus_hash": _hex(h.consensus_hash),
@@ -401,6 +403,7 @@ class RPCCore:
             "evidence_hash": _hex(h.evidence_hash),
             "proposer_address": _hex(h.proposer_address),
             "batch_hash": _hex(h.batch_hash),
+            "version": {"block": h.version_block, "app": h.version_app},
             "hash": _hex(h.hash()),
         }
 

@@ -1,68 +1,57 @@
-"""RPC-backed light-block provider + minimal JSON-RPC client.
+"""RPC-backed light-block provider.
 
 Reference: light/provider/http (provider over rpc/client/http). Fetches
 signed header + commit + validator set for a height from a node's RPC and
-assembles a LightBlock.
+assembles a LightBlock. The JSON-RPC transport is rpc/client.HTTPClient
+(one client implementation package-wide); `RPCClient` remains as its
+historical alias here.
 """
 
 from __future__ import annotations
 
-import asyncio
-import json
 from typing import Optional
 
+from .client import HTTPClient as RPCClient  # noqa: F401 (re-export)
 
-class RPCClient:
-    """Minimal JSON-RPC over HTTP POST client (reference rpc/client/http)."""
 
-    def __init__(self, addr: str):
-        # addr: "host:port" or "tcp://host:port" or "http://host:port"
-        s = addr
-        for prefix in ("tcp://", "http://"):
-            s = s.removeprefix(prefix)
-        host, _, port = s.rpartition(":")
-        self.host = host or "127.0.0.1"
-        self.port = int(port)
-        self._id = 0
+def header_from_json(hdr: dict):
+    """Parse a header from its RPC JSON form (rpc/core._header_json) and
+    return a types.Header whose .hash() is recomputed locally — callers
+    verifying untrusted responses must never trust a supplied hash."""
+    from ..types.block import Header
+    from ..types.block_id import BlockID
+    from ..types.part_set import PartSetHeader
 
-    async def call(self, method: str, **params):
-        self._id += 1
-        payload = json.dumps(
+    return Header(
+        chain_id=hdr["chain_id"],
+        height=hdr["height"],
+        time_ns=hdr["time"],
+        last_block_id=BlockID(
+            hash=bytes.fromhex(hdr["last_block_id"]["hash"]),
+            part_set_header=PartSetHeader(
+                hdr["last_block_id"]["parts"]["total"],
+                bytes.fromhex(hdr["last_block_id"]["parts"]["hash"]),
+            ),
+        ),
+        last_commit_hash=bytes.fromhex(hdr.get("last_commit_hash", "")),
+        data_hash=bytes.fromhex(hdr.get("data_hash", "")),
+        validators_hash=bytes.fromhex(hdr["validators_hash"]),
+        next_validators_hash=bytes.fromhex(hdr["next_validators_hash"]),
+        consensus_hash=bytes.fromhex(hdr["consensus_hash"]),
+        app_hash=bytes.fromhex(hdr["app_hash"]),
+        last_results_hash=bytes.fromhex(hdr["last_results_hash"]),
+        evidence_hash=bytes.fromhex(hdr["evidence_hash"]),
+        proposer_address=bytes.fromhex(hdr["proposer_address"]),
+        batch_hash=bytes.fromhex(hdr.get("batch_hash", "")),
+        **(
             {
-                "jsonrpc": "2.0",
-                "id": self._id,
-                "method": method,
-                "params": params,
+                "version_block": int(hdr["version"]["block"]),
+                "version_app": int(hdr["version"]["app"]),
             }
-        ).encode()
-        reader, writer = await asyncio.open_connection(self.host, self.port)
-        try:
-            writer.write(
-                b"POST / HTTP/1.1\r\nHost: rpc\r\n"
-                b"Content-Type: application/json\r\nContent-Length: "
-                + str(len(payload)).encode()
-                + b"\r\nConnection: close\r\n\r\n"
-                + payload
-            )
-            await writer.drain()
-            # parse response
-            status = await reader.readline()
-            if b"200" not in status:
-                raise ConnectionError(f"rpc http error: {status!r}")
-            n = 0
-            while True:
-                line = await reader.readline()
-                if line in (b"\r\n", b"\n", b""):
-                    break
-                if line.lower().startswith(b"content-length:"):
-                    n = int(line.split(b":", 1)[1])
-            body = await reader.readexactly(n) if n else await reader.read()
-            resp = json.loads(body)
-            if resp.get("error"):
-                raise RuntimeError(f"rpc error: {resp['error']}")
-            return resp["result"]
-        finally:
-            writer.close()
+            if "version" in hdr
+            else {}
+        ),
+    )
 
 
 class RPCProvider:
@@ -78,7 +67,7 @@ class RPCProvider:
 
     async def light_block(self, height: int):
         from ..light.types import LightBlock
-        from ..types.block import Commit, Header
+        from ..types.block import Commit
         from ..types.block_id import BlockID
         from ..types.part_set import PartSetHeader
         from ..types.block import BlockIDFlag, CommitSig
@@ -96,26 +85,7 @@ class RPCProvider:
             return None
         hdr = c["signed_header"]["header"]
         cm = c["signed_header"]["commit"]
-        header = Header(
-            chain_id=hdr["chain_id"],
-            height=hdr["height"],
-            time_ns=hdr["time"],
-            last_block_id=BlockID(
-                hash=bytes.fromhex(hdr["last_block_id"]["hash"]),
-                part_set_header=PartSetHeader(
-                    hdr["last_block_id"]["parts"]["total"],
-                    bytes.fromhex(hdr["last_block_id"]["parts"]["hash"]),
-                ),
-            ),
-            validators_hash=bytes.fromhex(hdr["validators_hash"]),
-            next_validators_hash=bytes.fromhex(hdr["next_validators_hash"]),
-            consensus_hash=bytes.fromhex(hdr["consensus_hash"]),
-            app_hash=bytes.fromhex(hdr["app_hash"]),
-            last_results_hash=bytes.fromhex(hdr["last_results_hash"]),
-            evidence_hash=bytes.fromhex(hdr["evidence_hash"]),
-            proposer_address=bytes.fromhex(hdr["proposer_address"]),
-            batch_hash=bytes.fromhex(hdr.get("batch_hash", "")),
-        )
+        header = header_from_json(hdr)
         commit = Commit(
             height=cm["height"],
             round=cm["round"],

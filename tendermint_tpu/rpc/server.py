@@ -32,13 +32,15 @@ class RPCError(Exception):
 
 
 class RPCServer(Service):
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 26657):
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 26657,
+                 core=None):
         super().__init__("rpc", getattr(node, "logger", None))
-        self.core = RPCCore(node)
+        self.core = core if core is not None else RPCCore(node)
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
         self._ws_tasks: set[asyncio.Task] = set()
+        self._conns: set[asyncio.StreamWriter] = set()
 
     async def on_start(self) -> None:
         self._server = await asyncio.start_server(
@@ -50,13 +52,20 @@ class RPCServer(Service):
     async def on_stop(self) -> None:
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+        # keep-alive clients hold connections open indefinitely; close
+        # them or wait_closed() (which awaits handler completion since
+        # py3.12) never returns
+        for w in list(self._conns):
+            w.close()
         for t in list(self._ws_tasks):
             t.cancel()
+        if self._server:
+            await self._server.wait_closed()
 
     # --- http plumbing ------------------------------------------------------
 
     async def _handle_conn(self, reader, writer) -> None:
+        self._conns.add(writer)
         try:
             while True:
                 req_line = await reader.readline()
@@ -97,6 +106,7 @@ class RPCServer(Service):
         ):
             pass
         finally:
+            self._conns.discard(writer)
             writer.close()
 
     async def _dispatch_http(self, method: str, target: str, body: bytes):

@@ -46,7 +46,15 @@ class ABCIResponses:
         return json.dumps(
             {
                 "deliver_txs": [
-                    {"code": r.code, "data": r.data.hex(), "log": r.log}
+                    {
+                        "code": r.code,
+                        "data": r.data.hex(),
+                        "log": r.log,
+                        "events": [
+                            {"type": e.type, "attributes": e.attributes}
+                            for e in r.events
+                        ],
+                    }
                     for r in self.deliver_txs
                 ],
             }
@@ -62,6 +70,10 @@ class ABCIResponses:
                     code=r.get("code", 0),
                     data=bytes.fromhex(r.get("data", "")),
                     log=r.get("log", ""),
+                    events=[
+                        abci.Event(e["type"], e.get("attributes", {}))
+                        for e in r.get("events", [])
+                    ],
                 )
             )
         return out

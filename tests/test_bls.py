@@ -204,3 +204,42 @@ def test_key_file_roundtrip(tmp_path):
     pub = bls.public_key_from_bytes(k.pub_key, trusted_source=False)
     sig = bls.sign(priv, b"from file")
     assert bls.verify(sig, b"from file", pub)
+
+
+def test_aggregate_many_signatures_one_verify():
+    """BASELINE config 3's shape: many validators BLS-sign one batch
+    hash; ONE aggregated signature + aggregated key verifies with 2
+    pairings (reference AggregateSignatures/AggregatePublicKeys +
+    VerifyAggregatedSameMessage, bls_signatures.go:129-149).
+
+    128 distinct keys here (keygen dominates test wall-time; the
+    aggregation/verification cost is INDEPENDENT of the signer count —
+    that independence is the property this test pins)."""
+    import time
+
+    n = 128
+    privs = [104729 + 7 * i for i in range(n)]
+    pubs = [bls.pubkey_from_priv(p) for p in privs]
+    msg = b"sealed-batch-hash"
+    sigs = [bls.sign(p, msg) for p in privs]
+
+    agg = bls.aggregate_signatures(sigs)
+    t0 = time.perf_counter()
+    assert bls.verify_aggregated_same_message(agg, msg, pubs)
+    dt_agg = time.perf_counter() - t0
+
+    # one flipped contribution breaks the aggregate
+    bad_sigs = list(sigs)
+    bad_sigs[57] = bls.sign(privs[57], b"different message")
+    assert not bls.verify_aggregated_same_message(
+        bls.aggregate_signatures(bad_sigs), msg, pubs
+    )
+    # aggregate missing one signer's key fails
+    assert not bls.verify_aggregated_same_message(agg, msg, pubs[:-1])
+    # the verify cost must not scale with n (2 pairings total): allow 3x
+    # headroom over a single-signature verify
+    t0 = time.perf_counter()
+    assert bls.verify(sigs[0], msg, pubs[0])
+    dt_one = time.perf_counter() - t0
+    assert dt_agg < 3 * dt_one + 0.5
+

@@ -1,0 +1,109 @@
+"""CLI commands (rewind/compact/reindex-event/replay/testnet) + the
+pprof debug server (reference cmd/tendermint + node.go:969-975)."""
+
+import asyncio
+import json
+import os
+
+from tendermint_tpu.__main__ import main
+from tendermint_tpu.node.node import Node, init_files
+
+from .test_node import make_test_config
+
+
+def _run_chain(tmp_path, heights=3, **cfg_kw):
+    cfg = make_test_config(tmp_path, **cfg_kw)
+    cfg.base.db_backend = "sqlite"  # the CLI operates on on-disk stores
+    init_files(cfg)
+    cfg.save()
+    node = Node(cfg)
+
+    async def run():
+        await node.start()
+        await node.consensus.wait_for_height(heights, timeout=60)
+        await node.stop()
+
+    asyncio.run(run())
+    return cfg
+
+
+def test_rewind_compact_reindex_replay(tmp_path, capsys):
+    cfg = _run_chain(tmp_path, heights=4)
+    home = ["--home", str(tmp_path)]
+
+    # replay prints WAL records
+    assert main(home + ["replay"]) == 0
+    out = capsys.readouterr().out
+    assert "replayed" in out and "WAL records" in out
+
+    # reindex rebuilds the tx index from stored blocks
+    assert main(home + ["reindex-event"]) == 0
+    assert "reindexed heights" in capsys.readouterr().out
+
+    # compact VACUUMs the stores
+    assert main(home + ["compact"]) == 0
+    assert "blockstore.db" in capsys.readouterr().out
+
+    # rewind drops back to height 2
+    assert main(home + ["rewind", "--height", "2"]) == 0
+    assert "rewound to height 2" in capsys.readouterr().out
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.store.kv import SqliteKV
+
+    bs = BlockStore(SqliteKV(os.path.join(cfg.db_dir, "blockstore.db")))
+    assert bs.height == 2
+
+
+def test_testnet_files(tmp_path, capsys):
+    out_dir = str(tmp_path / "net")
+    assert main(
+        ["--home", str(tmp_path), "testnet", "--v", "3", "--output", out_dir]
+    ) == 0
+    for i in range(3):
+        home = os.path.join(out_dir, f"node{i}")
+        assert os.path.exists(os.path.join(home, "config", "genesis.json"))
+        assert os.path.exists(os.path.join(home, "config", "config.toml"))
+    # all genesis docs identical
+    docs = {
+        open(os.path.join(out_dir, f"node{i}", "config", "genesis.json"))
+        .read()
+        for i in range(3)
+    }
+    assert len(docs) == 1
+
+
+def test_debug_server_endpoints(tmp_path):
+    cfg = make_test_config(tmp_path)
+    cfg.rpc.pprof_laddr = "tcp://127.0.0.1:0"
+    init_files(cfg)
+    node = Node(cfg)
+
+    async def fetch(port, path):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        await writer.drain()
+        data = await reader.read()
+        writer.close()
+        return data
+
+    async def run():
+        await node.start()
+        await node.consensus.wait_for_height(2, timeout=60)
+        port = node.debug_server.port
+
+        dump = await fetch(port, "/debug/pprof/goroutine")
+        assert b"200 OK" in dump
+        assert b"consensus/receive" in dump or b"thread" in dump
+
+        heap = await fetch(port, "/debug/pprof/heap")
+        assert b"200 OK" in heap
+
+        prof = await fetch(port, "/debug/pprof/profile?seconds=0.2")
+        assert b"200 OK" in prof and b"cumulative" in prof
+
+        bad = await fetch(port, "/debug/nope")
+        assert b"500" in bad
+
+        await node.stop()
+
+    asyncio.run(run())

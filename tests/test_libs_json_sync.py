@@ -1,0 +1,144 @@
+"""Tests for tmjson (amino JSON), HexBytes, rand, timer, sync watchdog."""
+
+import asyncio
+import dataclasses
+from datetime import datetime, timezone
+
+import pytest
+
+from tendermint_tpu.libs import rand as tmrand
+from tendermint_tpu.libs import tmjson
+from tendermint_tpu.libs.bytes import HexBytes
+from tendermint_tpu.libs.timer import ThrottleTimer
+
+
+# --- tmjson ---------------------------------------------------------------
+
+
+def test_int64_as_string_int32_as_number():
+    """Reference doc.go: int64(64) -> "64", int32(32) -> 32."""
+    assert tmjson.marshal(64) == b'"64"'
+    assert tmjson.marshal(tmjson.Int32(32)) == b"32"
+    assert tmjson.unmarshal(b'"64"', int) == 64
+    assert tmjson.unmarshal(b"32", tmjson.Int32) == 32
+
+
+def test_bytes_base64_hexbytes_hex():
+    assert tmjson.marshal(b"\x01\x02\x03") == b'"AQID"'
+    assert tmjson.marshal(HexBytes(b"\xde\xad")) == b'"DEAD"'
+    assert tmjson.unmarshal(b'"AQID"', bytes) == b"\x01\x02\x03"
+    assert tmjson.unmarshal(b'"DEAD"', HexBytes) == b"\xde\xad"
+
+
+def test_time_rfc3339nano_utc():
+    t = datetime(2026, 1, 2, 3, 4, 5, 600000, tzinfo=timezone.utc)
+    raw = tmjson.marshal(t)
+    assert raw == b'"2026-01-02T03:04:05.600000Z"'
+    assert tmjson.unmarshal(raw, datetime) == t
+
+
+@dataclasses.dataclass
+class _Car:
+    wheels: int = 4
+    name: str = ""
+
+
+@dataclasses.dataclass
+class _Garage:
+    vehicle: object = None
+
+
+def test_interface_envelope_roundtrip():
+    """Registered types wrap as {"type","value"} (types.go:17-31) and
+    decode back to the class from the envelope alone."""
+    tmjson.register_type(_Car, "test/Car")
+    raw = tmjson.marshal(_Car(wheels=4, name="benz"))
+    assert tmjson.unmarshal(raw) == _Car(wheels=4, name="benz")
+    data = tmjson.unmarshal(raw, None)
+    assert data.wheels == 4
+    # nested inside an unregistered struct
+    g = tmjson.unmarshal(tmjson.marshal(_Garage(vehicle=_Car(name="vw"))),
+                         _Garage)
+    assert g.vehicle == _Car(name="vw")
+
+
+def test_register_conflict_rejected():
+    with pytest.raises(ValueError):
+        tmjson.register_type(_Garage, "test/Car")
+
+
+def test_maps_require_string_keys():
+    assert tmjson.marshal({"a": 1}) == b'{"a":"1"}'
+    with pytest.raises(TypeError):
+        tmjson.marshal({True: 1})
+
+
+# --- HexBytes -------------------------------------------------------------
+
+
+def test_hexbytes_str_and_fingerprint():
+    h = HexBytes(bytes.fromhex("deadbeef"))
+    assert str(h) == "DEADBEEF"
+    assert h.fingerprint() == bytes.fromhex("deadbeef0000")
+
+
+# --- rand -----------------------------------------------------------------
+
+
+def test_rand_deterministic_after_seed():
+    tmrand.seed(42)
+    a = (tmrand.rand_str(12), tmrand.rand_bytes(8), tmrand.rand_intn(100))
+    tmrand.seed(42)
+    b = (tmrand.rand_str(12), tmrand.rand_bytes(8), tmrand.rand_intn(100))
+    assert a == b
+    assert len(a[0]) == 12 and a[0].isalnum()
+    assert sorted(tmrand.rand_perm(10)) == list(range(10))
+
+
+# --- ThrottleTimer --------------------------------------------------------
+
+
+def test_throttle_timer_coalesces_burst():
+    """A burst of set() calls fires once (throttle_timer.go:10-14)."""
+    fires = []
+
+    async def run():
+        async def cb():
+            fires.append(asyncio.get_running_loop().time())
+
+        t = ThrottleTimer("test", 0.05, cb)
+        for _ in range(10):
+            t.set()
+        await asyncio.sleep(0.12)
+        assert len(fires) == 1
+        # a second burst fires again
+        t.set()
+        t.set()
+        await asyncio.sleep(0.12)
+        assert len(fires) == 2
+        t.stop()
+        t.set()
+        await asyncio.sleep(0.08)
+        assert len(fires) == 2  # stopped: no more fires
+
+    asyncio.run(run())
+
+
+# --- sync watchdog --------------------------------------------------------
+
+
+def test_watchdog_detects_blocked_loop(capsys):
+    from tendermint_tpu.libs.sync import EventLoopWatchdog
+
+    async def run():
+        wd = EventLoopWatchdog(interval=0.05, misses=2)
+        wd.start()
+        import time
+
+        time.sleep(0.4)  # block the loop (the bug class being detected)
+        await asyncio.sleep(0.1)
+        wd.stop()
+
+    asyncio.run(run())
+    err = capsys.readouterr().err
+    assert "event loop stalled" in err

@@ -1,0 +1,88 @@
+"""Device SHA-256 / SHA-512 kernels vs hashlib + the mod-L reduction
+vs the host oracle (crypto/ed25519.py challenge)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import sha256 as dsha256
+from tendermint_tpu.ops import sha512 as dsha512
+
+LENGTHS = [0, 1, 3, 55, 56, 63, 64, 100, 111, 112, 127, 128, 200, 300]
+
+
+def test_sha256_batch_matches_hashlib():
+    msgs = [bytes([i & 0xFF] * n) for i, n in enumerate(LENGTHS)]
+    buf, counts = dsha256.pad_messages(msgs)
+    out = np.asarray(
+        dsha256.sha256_batch_jit(jnp.asarray(buf), jnp.asarray(counts))
+    )
+    for i, m in enumerate(msgs):
+        assert out[i].tobytes() == hashlib.sha256(m).digest(), f"len {len(m)}"
+
+
+def test_sha512_batch_matches_hashlib():
+    msgs = [bytes([(7 * i) & 0xFF] * n) for i, n in enumerate(LENGTHS)]
+    buf, counts = dsha512.pad_messages(msgs)
+    out = np.asarray(
+        dsha512.sha512_batch_jit(jnp.asarray(buf), jnp.asarray(counts))
+    )
+    for i, m in enumerate(msgs):
+        assert out[i].tobytes() == hashlib.sha512(m).digest(), f"len {len(m)}"
+
+
+def test_reduce_mod_l_edges():
+    """Adversarial 512-bit values: 0, 1, L-1, L, L+1, 2^252±1, all-FF —
+    canonical k = v mod L, bit-for-bit."""
+    L = dsha512.L
+    vals = [0, 1, L - 1, L, L + 1, (1 << 252) - 1, (1 << 252),
+            (1 << 256) - 1, (1 << 512) - 1, 12345 * L + 999]
+    digests = np.stack(
+        [
+            np.frombuffer(v.to_bytes(64, "little"), dtype=np.uint8)
+            for v in vals
+        ]
+    )
+    out = np.asarray(dsha512.reduce_mod_l(jnp.asarray(digests)))
+    for i, v in enumerate(vals):
+        want = (v % L).to_bytes(32, "little")
+        assert out[i].tobytes() == want, f"value index {i}"
+
+
+def test_challenge_batch_matches_host_oracle():
+    """k = SHA-512(R||A||M) mod L fused on device == host challenge()."""
+    from tendermint_tpu.crypto import ed25519 as host
+
+    rng = np.random.RandomState(7)
+    rows = []
+    for n in (13, 80, 120, 121, 122, 200):
+        r = rng.randint(0, 256, 32, dtype=np.uint8).tobytes()
+        a = rng.randint(0, 256, 32, dtype=np.uint8).tobytes()
+        m = rng.randint(0, 256, n, dtype=np.uint8).tobytes()
+        rows.append((r, a, m))
+    buf, counts = dsha512.pad_messages(
+        [m for _, _, m in rows], prefix_pairs=[r + a for r, a, _ in rows]
+    )
+    out = np.asarray(
+        dsha512.challenge_batch_jit(jnp.asarray(buf), jnp.asarray(counts))
+    )
+    for i, (r, a, m) in enumerate(rows):
+        want = host.challenge(r, a, m).to_bytes(32, "little")
+        assert out[i].tobytes() == want, f"row {i}"
+
+
+def test_merkle_device_matches_host():
+    from tendermint_tpu.crypto import merkle
+
+    leaves = [bytes([i] * 32) for i in range(8)]
+    arr = jnp.asarray(np.stack([np.frombuffer(x, np.uint8) for x in leaves]))
+    # leaf rule
+    dev_leaves = np.asarray(dsha256.merkle_leaf_hash(arr))
+    for i, x in enumerate(leaves):
+        assert dev_leaves[i].tobytes() == merkle.leaf_hash(x)
+    # full power-of-two tree
+    root = np.asarray(dsha256.merkle_root_pow2(arr)).tobytes()
+    assert root == merkle.hash_from_byte_slices(leaves)
