@@ -64,34 +64,58 @@ def _time_best(fn, *args) -> float:
     return best
 
 
+def _time_pipelined(fn, *args, depth: int = 8) -> float:
+    """Steady-state throughput: enqueue `depth` batches, then sync them all.
+
+    This is the shape of the bulk workloads (blocksync replay streams many
+    blocks' commit batches at the device — SURVEY.md §3.4); dispatch is
+    async, so the fixed host↔device round-trip latency amortizes across the
+    pipeline instead of taxing every batch. Returns seconds per batch."""
+    np.asarray(fn(*args))  # warm
+    best = float("inf")
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(depth)]
+        for o in outs:
+            assert np.asarray(o).all(), "pipelined batch failed to verify"
+        best = min(best, (time.perf_counter() - t0) / depth)
+    return best
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
     from tendermint_tpu.ops.ed25519_batch import (
-        neg_pubkey_table,
+        neg_pubkey_bigtable,
         verify_prehashed,
-        verify_prehashed_table,
+        verify_prehashed_bigcache,
     )
 
     pub, rb, sb, kb, s_ok = _build_args(BATCH)
 
-    # one-time validator table build (amortized over the validator's life)
+    # one-time validator fixed-window table build (amortized over the
+    # validator's life; the BatchVerifier caches these device-resident)
     t0 = time.perf_counter()
-    tables_u, valid_u = jax.jit(neg_pubkey_table)(pub[:128])
-    tables_u = jax.block_until_ready(tables_u)
+    tables, valid_u = jax.jit(neg_pubkey_bigtable)(pub[:128])
+    tables = jax.block_until_ready(tables)
+    np.asarray(valid_u)  # force through the tunnel
     build_t = time.perf_counter() - t0
     reps = (BATCH + 127) // 128
-    tables = jnp.tile(tables_u, (reps, 1, 1, 1))[:BATCH]
+    idx = jnp.asarray(np.tile(np.arange(128, dtype=np.int32), reps)[:BATCH])
     valid = jnp.tile(valid_u, (reps,))[:BATCH]
 
-    cached_fn = jax.jit(verify_prehashed_table)
-    dt_cached = _time_best(cached_fn, tables, valid, rb, sb, kb, s_ok)
+    cached_fn = jax.jit(verify_prehashed_bigcache)
+    dt_lat = _time_best(cached_fn, tables, valid, idx, rb, sb, kb, s_ok)
+    dt_cached = _time_pipelined(
+        cached_fn, tables, valid, idx, rb, sb, kb, s_ok
+    )
     cached_rate = BATCH / dt_cached
     print(
-        f"# cached-table path: {cached_rate:,.0f} sigs/s "
-        f"({dt_cached*1e3:.0f} ms/{BATCH}); table build (128 keys, incl. "
-        f"compile): {build_t:.1f}s",
+        f"# cached-table path: {cached_rate:,.0f} sigs/s pipelined "
+        f"({dt_cached*1e3:.0f} ms/{BATCH}); single-batch latency "
+        f"{dt_lat*1e3:.0f} ms ({BATCH/dt_lat:,.0f} sigs/s); table build "
+        f"(128 keys, incl. compile): {build_t:.1f}s",
         file=sys.stderr,
     )
 

@@ -15,6 +15,7 @@ import shutil
 import sys
 
 from .config import Config
+from .libs import cli as libs_cli
 from .version import (
     BLOCK_PROTOCOL_VERSION,
     P2P_PROTOCOL_VERSION,
@@ -251,7 +252,7 @@ def cmd_replay(args) -> int:
     from .consensus.wal import WAL
 
     cfg = _load_config(args)
-    wal_path = os.path.join(cfg.db_dir, "cs.wal")
+    wal_path = cfg.wal_file
     if not os.path.exists(wal_path):
         print(f"no WAL at {wal_path}")
         return 1
@@ -438,8 +439,7 @@ def main(argv=None) -> int:
         description="TPU-native tendermint (morph fork capabilities)",
     )
     p.add_argument(
-        "--home", default=os.environ.get("TMHOME", os.path.expanduser("~/.tendermint_tpu")),
-        help="node home directory",
+        "--home", default=libs_cli.default_home(), help="node home directory"
     )
     sub = p.add_subparsers(dest="cmd", required=True)
 

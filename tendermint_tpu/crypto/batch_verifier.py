@@ -44,9 +44,14 @@ from .ed25519 import L, challenge
 # large for blocksync/light-client bulk replay.
 BUCKETS = (8, 32, 128, 512, 2048, 8192)
 
-# default capacity of the device-resident validator table cache
-# ([cap, 16, 4, 32] int32 = cap * 8 KiB)
+# max capacity of the device-resident validator table cache. Fixed-window
+# tables are [64, 16, 4, 32] int32 = 512 KiB per key; the store is
+# allocated lazily and grown in power-of-two row counts, so the cap only
+# bounds the worst case (4096 keys = 2 GiB device memory).
 TABLE_CACHE_CAPACITY = 4096
+
+# initial allocated rows of the lazy table store
+_TABLE_ROWS_MIN = 128
 
 
 def _bucket(n: int, multiple_of: int = 1) -> int:
@@ -69,10 +74,15 @@ class SigItem:
 
 
 def _verify_cached(tables, tvalid, idx, rb, sb, kb, s_ok):
-    """Gather each row's table from the cache and verify (one jit)."""
-    t = jnp.take(tables, idx, axis=0)
+    """Verify against the shared fixed-window table cache (one jit).
+
+    The kernel gathers per-window slices internally so the 512 KiB
+    per-key tables are never materialized per batch row."""
     tv = jnp.take(tvalid, idx, axis=0) & (idx >= 0)
-    return ed25519_batch.verify_prehashed_table(t, tv, rb, sb, kb, s_ok)
+    safe_idx = jnp.maximum(idx, 0)
+    return ed25519_batch.verify_prehashed_bigcache(
+        tables, tv, safe_idx, rb, sb, kb, s_ok
+    )
 
 
 class BatchVerifier:
@@ -99,7 +109,7 @@ class BatchVerifier:
         if mesh is None:
             self._fn = jax.jit(ed25519_batch.verify_prehashed)
             self._cached_fn = jax.jit(_verify_cached)
-            self._build_fn = jax.jit(ed25519_batch.neg_pubkey_table)
+            self._build_fn = jax.jit(ed25519_batch.neg_pubkey_bigtable)
             self._nshards = 1
         else:
             sh = NamedSharding(mesh, P("batch"))
@@ -116,7 +126,7 @@ class BatchVerifier:
                 out_shardings=rep,
             )
             self._build_fn = jax.jit(
-                ed25519_batch.neg_pubkey_table,
+                ed25519_batch.neg_pubkey_bigtable,
                 in_shardings=(sh,),
                 out_shardings=(rep, rep),
             )
@@ -124,15 +134,29 @@ class BatchVerifier:
         # validator table cache (pubkey bytes -> row in the device array).
         # Guarded by a lock: the vote micro-batcher calls verify() from an
         # executor thread while the event-loop thread verifies serially.
+        # The store is allocated lazily and grows in power-of-two rows so
+        # idle verifiers cost nothing (512 KiB per row).
         self._cache_lock = threading.Lock()
         self._cache_capacity = table_cache_capacity
         self._cache_idx: dict[bytes, int] = {}
-        self._tables = jnp.zeros(
-            (max(1, table_cache_capacity), 16, 4, 32), dtype=jnp.int32
-        )
-        self._tables_valid = jnp.zeros(
-            max(1, table_cache_capacity), dtype=bool
-        )
+        self._tables: jnp.ndarray | None = None
+        self._tables_valid: jnp.ndarray | None = None
+
+    def _grow_store(self, needed_rows: int) -> None:
+        """Ensure the device store has >= needed_rows rows (lock held)."""
+        rows = _TABLE_ROWS_MIN
+        while rows < needed_rows:
+            rows *= 2
+        rows = min(rows, max(1, self._cache_capacity))
+        cur = 0 if self._tables is None else self._tables.shape[0]
+        if rows <= cur:
+            return
+        tables = jnp.zeros((rows, 64, 16, 4, 32), dtype=jnp.int32)
+        valid = jnp.zeros(rows, dtype=bool)
+        if cur:
+            tables = tables.at[:cur].set(self._tables)
+            valid = valid.at[:cur].set(self._tables_valid)
+        self._tables, self._tables_valid = tables, valid
 
     # --- table cache -------------------------------------------------------
 
@@ -162,23 +186,31 @@ class BatchVerifier:
                 if len(uniq) > self._cache_capacity:
                     return False  # batch alone exceeds capacity
                 self._cache_idx.clear()
-                self._tables_valid = jnp.zeros_like(self._tables_valid)
+                if self._tables_valid is not None:
+                    self._tables_valid = jnp.zeros_like(self._tables_valid)
                 new = uniq
-            b = _bucket(len(new), multiple_of=self._nshards)
-            arr = np.zeros((b, 32), dtype=np.uint8)
-            for i, pk in enumerate(new):
-                arr[i] = np.frombuffer(pk, dtype=np.uint8)
-            tables, valid = self._build_fn(jnp.asarray(arr))
-            rows = []
-            for pk in new:
-                row = len(self._cache_idx)
-                self._cache_idx[pk] = row
-                rows.append(row)
-            rows_j = jnp.asarray(np.asarray(rows, dtype=np.int32))
-            self._tables = self._tables.at[rows_j].set(tables[: len(new)])
-            self._tables_valid = self._tables_valid.at[rows_j].set(
-                valid[: len(new)]
-            )
+            self._grow_store(len(self._cache_idx) + len(new))
+            # chunked builds: a fixed-window table is 512 KiB, so building
+            # thousands of keys at once would transiently hold GiBs
+            for lo in range(0, len(new), 512):
+                chunk = new[lo : lo + 512]
+                b = _bucket(len(chunk), multiple_of=self._nshards)
+                arr = np.zeros((b, 32), dtype=np.uint8)
+                for i, pk in enumerate(chunk):
+                    arr[i] = np.frombuffer(pk, dtype=np.uint8)
+                tables, valid = self._build_fn(jnp.asarray(arr))
+                rows = []
+                for pk in chunk:
+                    row = len(self._cache_idx)
+                    self._cache_idx[pk] = row
+                    rows.append(row)
+                rows_j = jnp.asarray(np.asarray(rows, dtype=np.int32))
+                self._tables = self._tables.at[rows_j].set(
+                    tables[: len(chunk)]
+                )
+                self._tables_valid = self._tables_valid.at[rows_j].set(
+                    valid[: len(chunk)]
+                )
             return True
 
     # --- verification ------------------------------------------------------
@@ -230,6 +262,11 @@ class BatchVerifier:
             kb[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
             s_ok[i] = int.from_bytes(s, "little") < L
             well_formed.append(i)
+
+        if not well_formed:
+            # nothing to verify on device (malformed pubkey/sig lengths);
+            # also keeps the lazy table store untouched
+            return np.zeros(n, dtype=bool)
 
         if self._ensure_tables(
             [items[i].pubkey for i in well_formed]

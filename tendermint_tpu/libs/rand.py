@@ -44,9 +44,8 @@ def rand_uint64() -> int:
         return _rng.getrandbits(64)
 
 
-def rand_int63n(n: int) -> int:
-    with _lock:
-        return _rng.randrange(n)
+# reference Int63n: same contract as Intn for Python ints
+rand_int63n = rand_intn
 
 
 def rand_perm(n: int) -> list[int]:

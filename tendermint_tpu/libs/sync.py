@@ -61,6 +61,10 @@ class Lock:
     def acquire(self, blocking: bool = True, timeout: float = -1):
         if not DEADLOCK_ENABLED or not blocking:
             return self._lock.acquire(blocking, timeout)
+        if 0 <= timeout < self._timeout:
+            # caller's timed acquire is shorter than the deadlock window:
+            # preserve the timed-API contract (may return False)
+            return self._lock.acquire(True, timeout)
         got = self._lock.acquire(True, self._timeout)
         if not got:
             raise DeadlockError(

@@ -291,6 +291,79 @@ def scalar_mult_base(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.fori_loop(0, 64, body, identity(digs.shape[:-1]))
 
 
+def big_window_table(p: jnp.ndarray) -> jnp.ndarray:
+    """Per-element fixed-window table T[i, j] = cached([j * 16^i]P):
+    [..., 64, 16, 4, 32] int32 (512 KiB per element).
+
+    The doubling-free analogue of `_base_table` for a *variable* base: with
+    it, [k]P is 64 cached adds and zero doublings (`scalar_mult_var_bigtable`)
+    — the same shape the reference's serial verify can never reach because it
+    processes one signature at a time (crypto/ed25519/ed25519.go:148-162 in
+    /root/reference). Build cost (≈63×4 packed doublings over the 16-entry
+    axis) amortizes over a validator's lifetime: consensus re-verifies the
+    same pubkeys every height (SURVEY.md §3.3).
+    """
+    batch = p.shape[:-2]
+    # row of extended points [..., 16, 4, 32]: 0, P, ..., 15P
+    entries = [identity(batch), p]
+    for _ in range(14):
+        entries.append(add(entries[-1], p))
+    row = jnp.stack(entries, axis=-3)
+
+    def body(_, row):
+        return double(double(double(double(row))))
+
+    # rows[i] = [16^i] * row ; unrolled scan keeps build a single program
+    def scan_body(row, _):
+        nxt = body(None, row)
+        return nxt, to_cached(row)
+
+    _, rows = jax.lax.scan(scan_body, row, None, length=64)
+    # rows: [64, ..., 16, 4, 32] -> [..., 64, 16, 4, 32]
+    return jnp.moveaxis(rows, 0, -4)
+
+
+def scalar_mult_var_bigtable(
+    scalar_bytes: jnp.ndarray, table: jnp.ndarray
+) -> jnp.ndarray:
+    """[s]P from a prebuilt fixed-window table ([..., 64, 16, 4, 32]).
+
+    64 cached adds, no doublings — 2 packed muls per digit vs the 10 of
+    `scalar_mult_var_table`."""
+    digs = nibbles(scalar_bytes)  # [..., 64] LSB-first
+    batch_shape = digs.shape[:-1]
+
+    def body(i, acc):
+        row = jax.lax.dynamic_index_in_dim(
+            table, i, axis=table.ndim - 4, keepdims=False
+        )  # [..., 16, 4, 32]
+        return add_cached(acc, _select_entry(row, digs[..., i]))
+
+    return jax.lax.fori_loop(0, 64, body, identity(batch_shape))
+
+
+def scalar_mult_var_bigcache(
+    scalar_bytes: jnp.ndarray,  # [B, 32] u8
+    tables_cache: jnp.ndarray,  # [cap, 64, 16, 4, 32] fixed-window tables
+    idx: jnp.ndarray,  # [B] int32 row index into the cache
+) -> jnp.ndarray:
+    """[s]·T[idx] against a shared device-resident table cache.
+
+    Gathers one window-row slice per iteration ([cap, 16, 4, 32] sliced,
+    then a [B]-gather of the selected digit entries) so the full 512 KiB
+    per-key tables are never materialized per batch element."""
+    digs = nibbles(scalar_bytes)  # [B, 64] LSB-first
+
+    def body(i, acc):
+        row = jax.lax.dynamic_index_in_dim(
+            tables_cache, i, axis=1, keepdims=False
+        )  # [cap, 16, 4, 32]
+        ent = row[idx, digs[..., i]]  # [B, 4, 32]
+        return add_cached(acc, ent)
+
+    return jax.lax.fori_loop(0, 64, body, identity(digs.shape[:-1]))
+
+
 def scalar_mult_var_table(
     scalar_bytes: jnp.ndarray, table: jnp.ndarray
 ) -> jnp.ndarray:

@@ -72,5 +72,56 @@ def verify_prehashed_table(
     return table_valid & s_ok & r_match
 
 
+def neg_pubkey_bigtable(
+    pubkeys: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fixed-window tables for -A per pubkey: doubling-free verification.
+
+    pubkeys: [N, 32] u8 -> (tables [N, 64, 16, 4, 32] i32, valid [N] bool).
+    512 KiB per key; built once per validator (SURVEY.md §3.3 — the same
+    validators sign every height), after which each verify is 128 cached
+    adds and zero doublings.
+    """
+    a_point, a_valid = curve.decompress(pubkeys)
+    return curve.big_window_table(curve.neg(a_point)), a_valid
+
+
+def verify_prehashed_bigtable(
+    tables: jnp.ndarray,  # [B, 64, 16, 4, 32] fixed-window tables of -A
+    table_valid: jnp.ndarray,  # [B] bool
+    r_bytes: jnp.ndarray,  # [B, 32] uint8
+    s_bytes: jnp.ndarray,  # [B, 32] uint8
+    k_bytes: jnp.ndarray,  # [B, 32] uint8
+    s_ok: jnp.ndarray,  # [B] bool
+) -> jnp.ndarray:
+    """Accept bitmap via the doubling-free fixed-window hot path."""
+    q = curve.add(
+        curve.scalar_mult_base(s_bytes),
+        curve.scalar_mult_var_bigtable(k_bytes, tables),
+    )
+    encoded = curve.compress(q)
+    r_match = jnp.all(encoded == r_bytes, axis=-1)
+    return table_valid & s_ok & r_match
+
+
+def verify_prehashed_bigcache(
+    tables_cache: jnp.ndarray,  # [cap, 64, 16, 4, 32] shared table cache
+    table_valid: jnp.ndarray,  # [B] bool (row's pubkey decompressed OK)
+    idx: jnp.ndarray,  # [B] int32 row index into the cache
+    r_bytes: jnp.ndarray,  # [B, 32] uint8
+    s_bytes: jnp.ndarray,  # [B, 32] uint8
+    k_bytes: jnp.ndarray,  # [B, 32] uint8
+    s_ok: jnp.ndarray,  # [B] bool
+) -> jnp.ndarray:
+    """The BatchVerifier steady-state path: doubling-free, cache-resident."""
+    q = curve.add(
+        curve.scalar_mult_base(s_bytes),
+        curve.scalar_mult_var_bigcache(k_bytes, tables_cache, idx),
+    )
+    encoded = curve.compress(q)
+    r_match = jnp.all(encoded == r_bytes, axis=-1)
+    return table_valid & s_ok & r_match
+
+
 verify_prehashed_jit = jax.jit(verify_prehashed)
 verify_prehashed_table_jit = jax.jit(verify_prehashed_table)

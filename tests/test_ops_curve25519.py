@@ -111,3 +111,29 @@ def test_scalar_mult_var_matches_host():
     _assert_points_equal(
         out, [host.scalar_mult(s, p) for s, p in zip(scalars, pts)]
     )
+
+
+def test_scalar_mult_var_bigtable_matches_host():
+    """Fixed-window (doubling-free) variable-base path, both table forms."""
+    pts = _rand_points(3, seed=9)
+    scalars = [0, host.L - 1, 2**256 - 19]
+    sb = jnp.asarray(
+        np.stack(
+            [
+                np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8)
+                for s in scalars
+            ]
+        )
+    )
+    tables = jax.jit(curve.big_window_table)(_to_batch(pts))
+    expected = [host.scalar_mult(s, p) for s, p in zip(scalars, pts)]
+
+    out = jax.jit(curve.scalar_mult_var_bigtable)(sb, tables)
+    _assert_points_equal(out, expected)
+
+    # cache form: rows permuted, gathered back by index
+    idx = jnp.asarray(np.array([2, 0, 1], dtype=np.int32))
+    cache = jnp.take(tables, idx, axis=0)  # cache[j] = tables[idx[j]]
+    inv = jnp.asarray(np.array([1, 2, 0], dtype=np.int32))
+    out2 = jax.jit(curve.scalar_mult_var_bigcache)(sb, cache, inv)
+    _assert_points_equal(out2, expected)
