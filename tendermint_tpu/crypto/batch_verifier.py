@@ -268,14 +268,27 @@ class BatchVerifier:
             # also keeps the lazy table store untouched
             return np.zeros(n, dtype=bool)
 
-        if self._ensure_tables(
-            [items[i].pubkey for i in well_formed]
-        ):
+        # Two attempts: a concurrent verify() can trigger the cache-reset
+        # path between our _ensure_tables and the index read, evicting our
+        # rows; on a second miss fall through to the generic path rather
+        # than mis-rejecting (or crashing on) valid signatures.
+        for _ in range(2):
+            if not self._ensure_tables(
+                [items[i].pubkey for i in well_formed]
+            ):
+                break  # cache cannot hold this batch: generic path
             with self._cache_lock:
                 tables, tvalid = self._tables, self._tables_valid
                 idx = np.full(b, -1, dtype=np.int32)
+                evicted = False
                 for i in well_formed:
-                    idx[i] = self._cache_idx[items[i].pubkey]
+                    row = self._cache_idx.get(items[i].pubkey)
+                    if row is None:
+                        evicted = True
+                        break
+                    idx[i] = row
+            if evicted:
+                continue
             out = self._cached_fn(
                 tables,
                 tvalid,
@@ -296,12 +309,16 @@ class BatchVerifier:
 
     @staticmethod
     def _verify_host_other(it: SigItem) -> bool:
-        """Host verify for non-ed25519 key types (secp256k1 today; the
+        """Host verify for non-ed25519 key types (secp256k1/sr25519; the
         device kernel partition point for future per-type kernels)."""
         if it.key_type == "secp256k1":
             from . import secp256k1
 
             return secp256k1.PubKey(it.pubkey).verify(it.msg, it.sig)
+        if it.key_type == "sr25519":
+            from . import sr25519
+
+            return sr25519.PubKey(it.pubkey).verify(it.msg, it.sig)
         return False
 
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:

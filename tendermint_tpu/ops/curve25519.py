@@ -257,38 +257,37 @@ _BASE_TABLE_NP: np.ndarray | None = None
 
 
 def _base_table() -> np.ndarray:
-    """T[i, j] = cached([j * 16^i]B) as [64, 16, 4, 32] int32 (host,
-    once)."""
+    """T[i, j] = cached([j * 256^i]B) as [32, 256, 4, 32] int32 (host,
+    once). Radix-256: the scalar's bytes ARE the digits, and [s]B is 32
+    cached adds (vs 64 for radix-16) — the table is host-precomputed so
+    the wider window costs only one-time build and 4 MiB of constants."""
     global _BASE_TABLE_NP
     if _BASE_TABLE_NP is None:
         rows = []
-        row = [host.IDENTITY]
-        for j in range(1, 16):
-            row.append(host.point_add(row[-1], host.BASEPOINT))
-        for _ in range(64):
+        base = host.BASEPOINT
+        for _ in range(32):
+            row = [host.IDENTITY]
+            for _ in range(255):
+                row.append(host.point_add(row[-1], base))
             rows.append([from_host_point_cached(p) for p in row])
-            row = [
-                host.point_double(
-                    host.point_double(host.point_double(host.point_double(p)))
-                )
-                for p in row
-            ]
+            for _ in range(8):
+                base = host.point_double(base)
         _BASE_TABLE_NP = np.asarray(rows, dtype=np.int32)
     return _BASE_TABLE_NP
 
 
 def scalar_mult_base(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
     """[s]B for s: [..., 32] u8 (little-endian, < 2^256). No doublings:
-    sum over 64 radix-16 digit rows of the precomputed basepoint table."""
-    digs = nibbles(scalar_bytes)  # [..., 64] LSB-first
-    table = jnp.asarray(_base_table())  # [64, 16, 4, 32] cached
+    sum over the 32 byte-digit rows of the precomputed basepoint table."""
+    digs = scalar_bytes.astype(jnp.int32)  # [..., 32] LSB-first bytes
+    table = jnp.asarray(_base_table())  # [32, 256, 4, 32] cached
 
     def body(i, acc):
         row = jax.lax.dynamic_index_in_dim(table, i, keepdims=False)
         entry = jnp.take(row, digs[..., i], axis=0)  # [..., 4, 32]
         return add_cached(acc, entry)
 
-    return jax.lax.fori_loop(0, 64, body, identity(digs.shape[:-1]))
+    return jax.lax.fori_loop(0, 32, body, identity(digs.shape[:-1]))
 
 
 def big_window_table(p: jnp.ndarray) -> jnp.ndarray:
@@ -310,15 +309,14 @@ def big_window_table(p: jnp.ndarray) -> jnp.ndarray:
         entries.append(add(entries[-1], p))
     row = jnp.stack(entries, axis=-3)
 
-    def body(_, row):
-        return double(double(double(double(row))))
-
-    # rows[i] = [16^i] * row ; unrolled scan keeps build a single program
+    # rows[i] = [16^i] * row (63 scan steps; the last row is emitted
+    # without paying a final wasted doubling round)
     def scan_body(row, _):
-        nxt = body(None, row)
+        nxt = double(double(double(double(row))))
         return nxt, to_cached(row)
 
-    _, rows = jax.lax.scan(scan_body, row, None, length=64)
+    last, rows = jax.lax.scan(scan_body, row, None, length=63)
+    rows = jnp.concatenate([rows, to_cached(last)[None]], axis=0)
     # rows: [64, ..., 16, 4, 32] -> [..., 64, 16, 4, 32]
     return jnp.moveaxis(rows, 0, -4)
 

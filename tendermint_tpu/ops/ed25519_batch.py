@@ -86,24 +86,6 @@ def neg_pubkey_bigtable(
     return curve.big_window_table(curve.neg(a_point)), a_valid
 
 
-def verify_prehashed_bigtable(
-    tables: jnp.ndarray,  # [B, 64, 16, 4, 32] fixed-window tables of -A
-    table_valid: jnp.ndarray,  # [B] bool
-    r_bytes: jnp.ndarray,  # [B, 32] uint8
-    s_bytes: jnp.ndarray,  # [B, 32] uint8
-    k_bytes: jnp.ndarray,  # [B, 32] uint8
-    s_ok: jnp.ndarray,  # [B] bool
-) -> jnp.ndarray:
-    """Accept bitmap via the doubling-free fixed-window hot path."""
-    q = curve.add(
-        curve.scalar_mult_base(s_bytes),
-        curve.scalar_mult_var_bigtable(k_bytes, tables),
-    )
-    encoded = curve.compress(q)
-    r_match = jnp.all(encoded == r_bytes, axis=-1)
-    return table_valid & s_ok & r_match
-
-
 def verify_prehashed_bigcache(
     tables_cache: jnp.ndarray,  # [cap, 64, 16, 4, 32] shared table cache
     table_valid: jnp.ndarray,  # [B] bool (row's pubkey decompressed OK)

@@ -21,6 +21,10 @@ def pubkey_from_type(type_name: str, data: bytes):
         from ..crypto import secp256k1
 
         return secp256k1.PubKey(data)
+    if type_name == "sr25519":
+        from ..crypto import sr25519
+
+        return sr25519.PubKey(data)
     raise ValueError(f"unknown pubkey type {type_name!r}")
 
 

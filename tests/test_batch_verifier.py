@@ -90,3 +90,40 @@ def test_mixed_large_batch():
 
 def test_empty_batch():
     assert default_verifier().verify([]).shape == (0,)
+
+
+def test_mixed_key_types_partition():
+    """ed25519 rides the device; secp256k1/sr25519 partition to host and
+    the bitmap re-interleaves (BASELINE config 4, mixed-key commits)."""
+    from tendermint_tpu.crypto import secp256k1, sr25519
+
+    ed = _keypairs(8)
+    ks = secp256k1.PrivKey.from_secret(b"s1")
+    kr = sr25519.PrivKey.from_secret(b"r1")
+    items, want = [], []
+    for i, k in enumerate(ed):
+        msg = b"ed%d" % i
+        items.append(SigItem(k.public_key().data, msg, k.sign(msg)))
+        want.append(True)
+    items.insert(3, SigItem(ks.public_key().data, b"secp", ks.sign(b"secp"),
+                            key_type="secp256k1"))
+    want.insert(3, True)
+    items.insert(6, SigItem(kr.public_key().data, b"sr", kr.sign(b"sr"),
+                            key_type="sr25519"))
+    want.insert(6, True)
+    # corrupt the sr25519 row's message
+    items.append(SigItem(kr.public_key().data, b"sr!", kr.sign(b"sr"),
+                         key_type="sr25519"))
+    want.append(False)
+    got = default_verifier().verify(items)
+    assert got.tolist() == want
+
+
+def test_malformed_only_batch_rejects():
+    """A device-size batch with zero well-formed rows returns all-False
+    (no crash on the lazily-allocated table store)."""
+    items = [
+        SigItem(b"\x00" * 31, b"m%d" % i, b"\x00" * 64) for i in range(9)
+    ]
+    got = BatchVerifier().verify(items)
+    assert got.shape == (9,) and not got.any()

@@ -1,0 +1,102 @@
+"""sr25519 stack: merlin (published vector), ristretto255 (RFC 9496
+vectors), schnorrkel sign/verify semantics."""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ristretto, sr25519
+from tendermint_tpu.crypto.ed25519 import BX, BY, P, point_add, scalar_mult
+from tendermint_tpu.crypto.merlin import Transcript
+
+B = (BX, BY, 1, BX * BY % P)
+
+
+def test_merlin_conformance_vector():
+    """The Merlin crate's own equivalence test vector."""
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    c = t.challenge_bytes(b"challenge", 32)
+    assert (
+        c.hex()
+        == "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+    )
+
+
+def test_ristretto_small_multiples():
+    """RFC 9496 appendix A: encodings of 0..4 times the generator."""
+    expected = [
+        "0000000000000000000000000000000000000000000000000000000000000000",
+        "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+        "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+        "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+        "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+    ]
+    pt = (0, 1, 1, 0)
+    for exp in expected:
+        assert ristretto.encode(pt).hex() == exp
+        pt = point_add(pt, B)
+
+
+def test_ristretto_decode_roundtrip_and_rejects():
+    for i in range(1, 6):
+        p = scalar_mult(i, B)
+        enc = ristretto.encode(p)
+        dec = ristretto.decode(enc)
+        assert dec is not None and ristretto.equal(dec, p)
+        assert ristretto.encode(dec) == enc
+    # non-canonical: s >= p
+    assert ristretto.decode((P + 1).to_bytes(32, "little")) is None
+    # negative: odd s
+    assert ristretto.decode((1).to_bytes(32, "little")) is None
+    # RFC 9496: invalid encoding (not on curve)
+    bad = bytes.fromhex(
+        "26948d35ca62e643e26a83177332e6b6afeb9d08e4268b650f1f5bbd8d81d371"
+    )
+    assert ristretto.decode(bad) is None
+
+
+def test_sign_verify_roundtrip():
+    k = sr25519.PrivKey.from_secret(b"validator-1")
+    pub = k.public_key()
+    msg = b"vote sign bytes"
+    sig = k.sign(msg)
+    assert len(sig) == 64 and sig[63] & 0x80
+    assert pub.verify(msg, sig)
+    # wrong message
+    assert not pub.verify(msg + b"x", sig)
+    # flipped signature byte
+    bad = bytearray(sig)
+    bad[0] ^= 1
+    assert not pub.verify(msg, bytes(bad))
+    # marker bit cleared -> not a schnorrkel sig
+    bad = bytearray(sig)
+    bad[63] &= 0x7F
+    assert not pub.verify(msg, bytes(bad))
+    # wrong key
+    assert not sr25519.PrivKey.from_secret(b"other").public_key().verify(
+        msg, sig
+    )
+
+
+def test_expand_ed25519_shape():
+    import hashlib
+
+    mini = b"\x01" * 32
+    scalar, nonce = sr25519.expand_ed25519(mini)
+    # clamped (bit 254 set, low 3 bits clear) then divided by the cofactor:
+    # scalar * 8 must reconstruct the clamped SHA-512 prefix exactly
+    h = bytearray(hashlib.sha512(mini).digest()[:32])
+    h[0] &= 248
+    h[31] &= 63
+    h[31] |= 64
+    assert scalar * 8 == int.from_bytes(bytes(h), "little")
+    assert 2**251 <= scalar < 2**252
+    assert nonce == hashlib.sha512(mini).digest()[32:]
+    assert len(nonce) == 32
+
+
+def test_pubkey_deterministic_and_sized():
+    k = sr25519.PrivKey.from_bytes(b"\x07" * 32)
+    p1, p2 = k.public_key(), k.public_key()
+    assert p1 == p2 and len(p1.data) == 32
+    assert len(k.public_key().address()) == 20
