@@ -11,16 +11,18 @@ accept bitmap back.
 Responsibilities:
 - per-item host work: SHA-512 challenge k = H(R||A||M) mod L (arbitrary
   message length lives here, not in the fixed-shape kernel) and the s < L
-  range check;
+  range check; bulk batches instead fuse the challenge hashing into the
+  device program (ops/sha512.challenge_batch);
 - shape discipline: batches are padded up to a small set of bucket sizes so
   XLA compiles a handful of programs, not one per batch size;
-- the validator-table cache: consensus re-verifies the SAME pubkeys every
-  height (2N sigs/height from one validator set — SURVEY.md §3.3), so each
-  pubkey's decompressed negated window table is built once, stored in a
-  device-resident array, and gathered by row index at verify time — the
-  steady-state vote path skips decompression and table construction
-  entirely;
-- mixed key types: non-ed25519 rows (secp256k1) partition to host verify;
+- the validator-table cache, in two tiers. Consensus re-verifies the SAME
+  pubkeys every height (2N sigs/height from one validator set — SURVEY.md
+  §3.3), so each pubkey's decompressed negated table is built once and kept
+  device-resident. Small (latency-sensitive, vote-sized) batches use radix-16
+  window tables (8 KiB/key, cheap to build inline); bulk batches (blocksync/
+  light replay) use doubling-free fixed-window tables (512 KiB/key, ~64x the
+  build cost — amortized over thousands of reuses, 2.5x faster to verify);
+- mixed key types: non-ed25519 rows (secp256k1/sr25519) partition to host;
 - optional mesh sharding: with a `jax.sharding.Mesh`, the batch axis is
   sharded across devices (`NamedSharding`) so one commit's votes spread over
   ICI — the "data-parallel batch sharding" strategy of SURVEY.md §2.3.
@@ -44,13 +46,18 @@ from .ed25519 import L, challenge
 # large for blocksync/light-client bulk replay.
 BUCKETS = (8, 32, 128, 512, 2048, 8192)
 
-# max capacity of the device-resident validator table cache. Fixed-window
-# tables are [64, 16, 4, 32] int32 = 512 KiB per key; the store is
-# allocated lazily and grown in power-of-two row counts, so the cap only
-# bounds the worst case (4096 keys = 2 GiB device memory).
+# max rows of the device-resident table caches. Small tier: radix-16 window
+# tables, 8 KiB/key. Big tier: fixed-window tables, 512 KiB/key (4096 keys
+# = 2 GiB worst case; both stores allocate lazily and grow in power-of-two
+# row counts, so the cap only bounds the worst case).
 TABLE_CACHE_CAPACITY = 4096
 
-# initial allocated rows of the lazy table store
+# batches >= this bucket size use the big (doubling-free) tier; smaller
+# batches are latency-sensitive (live votes) and must not stall on the big
+# tier's expensive one-time table build
+BIGTABLE_MIN = 512
+
+# initial allocated rows of the lazy table stores
 _TABLE_ROWS_MIN = 128
 
 
@@ -73,16 +80,117 @@ class SigItem:
     key_type: str = "ed25519"
 
 
-def _verify_cached(tables, tvalid, idx, rb, sb, kb, s_ok):
-    """Verify against the shared fixed-window table cache (one jit).
+def _verify_cached_small(tables, tvalid, idx, rb, sb, kb, s_ok):
+    """Small tier: gather each row's radix-16 window table and verify."""
+    t = jnp.take(tables, jnp.maximum(idx, 0), axis=0)
+    tv = jnp.take(tvalid, jnp.maximum(idx, 0), axis=0) & (idx >= 0)
+    return ed25519_batch.verify_prehashed_table(t, tv, rb, sb, kb, s_ok)
 
-    The kernel gathers per-window slices internally so the 512 KiB
-    per-key tables are never materialized per batch row."""
-    tv = jnp.take(tvalid, idx, axis=0) & (idx >= 0)
-    safe_idx = jnp.maximum(idx, 0)
+
+def _verify_cached_big(tables, tvalid, idx, rb, sb, kb, s_ok):
+    """Big tier: doubling-free fixed-window verify against the shared
+    cache (the kernel gathers per-window slices internally so the 512 KiB
+    per-key tables are never materialized per batch row)."""
+    tv = jnp.take(tvalid, jnp.maximum(idx, 0), axis=0) & (idx >= 0)
     return ed25519_batch.verify_prehashed_bigcache(
-        tables, tv, safe_idx, rb, sb, kb, s_ok
+        tables, tv, jnp.maximum(idx, 0), rb, sb, kb, s_ok
     )
+
+
+def _verify_cached_msgs(tables, tvalid, idx, rb, sb, msg_buf, n_blocks, s_ok):
+    """Big tier + SHA-512 challenges fused on device (one jit)."""
+    tv = jnp.take(tvalid, jnp.maximum(idx, 0), axis=0) & (idx >= 0)
+    return ed25519_batch.verify_msgs_bigcache(
+        tables, tv, jnp.maximum(idx, 0), rb, sb, msg_buf, n_blocks, s_ok
+    )
+
+
+class _TableCache:
+    """One device-resident table store (pubkey -> row), lazily grown.
+
+    Thread-safety: all methods take the shared verifier lock — the vote
+    micro-batcher calls verify() from an executor thread while the event
+    loop verifies serially."""
+
+    def __init__(self, lock, build_fn, entry_shape, capacity, nshards):
+        self._lock = lock
+        self._build_fn = build_fn
+        self._entry_shape = entry_shape  # per-key table dims after the row
+        self._capacity = capacity
+        self._nshards = nshards
+        self._idx: dict[bytes, int] = {}
+        self.tables: jnp.ndarray | None = None
+        self.valid: jnp.ndarray | None = None
+
+    def _grow(self, needed_rows: int) -> None:
+        rows = _TABLE_ROWS_MIN
+        while rows < needed_rows:
+            rows *= 2
+        rows = min(rows, max(1, self._capacity))
+        cur = 0 if self.tables is None else self.tables.shape[0]
+        if rows <= cur:
+            return
+        tables = jnp.zeros((rows, *self._entry_shape), dtype=jnp.int32)
+        valid = jnp.zeros(rows, dtype=bool)
+        if cur:
+            tables = tables.at[:cur].set(self.tables)
+            valid = valid.at[:cur].set(self.valid)
+        self.tables, self.valid = tables, valid
+
+    def ensure(self, pubkeys: list[bytes]) -> bool:
+        """Build + install tables for unseen pubkeys. Returns False when
+        the batch alone exceeds capacity. The cache resets when full
+        (validator rotation must not silently degrade the hot path)."""
+        with self._lock:
+            new = []
+            seen = set()
+            for pk in pubkeys:
+                if pk not in self._idx and pk not in seen:
+                    seen.add(pk)
+                    new.append(pk)
+            if not new:
+                return True
+            if len(self._idx) + len(new) > self._capacity:
+                uniq = list(dict.fromkeys(pubkeys))
+                if len(uniq) > self._capacity:
+                    return False
+                self._idx.clear()
+                if self.valid is not None:
+                    self.valid = jnp.zeros_like(self.valid)
+                new = uniq
+            self._grow(len(self._idx) + len(new))
+            # chunked builds: big-tier tables are 512 KiB each, so building
+            # thousands of keys at once would transiently hold GiBs
+            for lo in range(0, len(new), 512):
+                chunk = new[lo : lo + 512]
+                b = _bucket(len(chunk), multiple_of=self._nshards)
+                arr = np.zeros((b, 32), dtype=np.uint8)
+                for i, pk in enumerate(chunk):
+                    arr[i] = np.frombuffer(pk, dtype=np.uint8)
+                tables, valid = self._build_fn(jnp.asarray(arr))
+                rows = []
+                for pk in chunk:
+                    row = len(self._idx)
+                    self._idx[pk] = row
+                    rows.append(row)
+                rows_j = jnp.asarray(np.asarray(rows, dtype=np.int32))
+                self.tables = self.tables.at[rows_j].set(
+                    tables[: len(chunk)]
+                )
+                self.valid = self.valid.at[rows_j].set(valid[: len(chunk)])
+            return True
+
+    def snapshot(self, row_pubkeys: list[tuple[int, bytes]], b: int):
+        """(tables, valid, idx[b]) for the given (row, pubkey) pairs, or
+        None if any pubkey was concurrently evicted (caller retries)."""
+        with self._lock:
+            idx = np.full(b, -1, dtype=np.int32)
+            for i, pk in row_pubkeys:
+                row = self._idx.get(pk)
+                if row is None:
+                    return None
+                idx[i] = row
+            return self.tables, self.valid, idx
 
 
 class BatchVerifier:
@@ -99,17 +207,39 @@ class BatchVerifier:
         mesh: Mesh | None = None,
         min_device_batch: int = 8,
         table_cache_capacity: int = TABLE_CACHE_CAPACITY,
+        device_challenge_min: int | None = None,
+        bigtable_min: int = BIGTABLE_MIN,
     ):
         """min_device_batch: below this size the host CPU verifies serially
         — a device round-trip costs more than a handful of host verifies
         (the adaptive micro-batching tradeoff, SURVEY.md §7.3 hard part 3).
-        Set to 0 to force everything onto the device."""
+        Set to 0 to force everything onto the device.
+
+        device_challenge_min: batches >= this size compute the SHA-512
+        challenges on device (fused into the verify program) instead of on
+        the host thread. None (default) keeps hashing on the host: hashlib
+        sustains ~600k sigs/s on one core, so host hashing only becomes the
+        bottleneck at real-silicon verify rates — enable this (e.g. 2048)
+        when deploying where the device outruns the host hasher; measured
+        end-to-end on the harness chip, where the fused program verifies
+        correctly but the executor's SHA throughput is below hashlib's.
+
+        bigtable_min: batches >= this bucket size use doubling-free
+        fixed-window tables (2.5x faster steady-state, ~64x build cost);
+        smaller batches use cheap-to-build radix-16 tables so live vote
+        verification never stalls behind a table build."""
         self._mesh = mesh
         self._min_device_batch = min_device_batch
+        self._device_challenge_min = device_challenge_min
+        self._bigtable_min = bigtable_min
         if mesh is None:
-            self._fn = jax.jit(ed25519_batch.verify_prehashed)
-            self._cached_fn = jax.jit(_verify_cached)
-            self._build_fn = jax.jit(ed25519_batch.neg_pubkey_bigtable)
+            jit = jax.jit
+            self._fn = jit(ed25519_batch.verify_prehashed)
+            self._small_fn = jit(_verify_cached_small)
+            self._big_fn = jit(_verify_cached_big)
+            self._msgs_fn = jit(_verify_cached_msgs)
+            build_small = jit(ed25519_batch.neg_pubkey_table)
+            build_big = jit(ed25519_batch.neg_pubkey_bigtable)
             self._nshards = 1
         else:
             sh = NamedSharding(mesh, P("batch"))
@@ -119,99 +249,77 @@ class BatchVerifier:
                 in_shardings=(sh, sh, sh, sh, sh),
                 out_shardings=rep,
             )
-            # table cache stays replicated; the batch axis shards
-            self._cached_fn = jax.jit(
-                _verify_cached,
+            # table caches stay replicated; the batch axis shards
+            self._small_fn = jax.jit(
+                _verify_cached_small,
                 in_shardings=(rep, rep, sh, sh, sh, sh, sh),
                 out_shardings=rep,
             )
-            self._build_fn = jax.jit(
+            self._big_fn = jax.jit(
+                _verify_cached_big,
+                in_shardings=(rep, rep, sh, sh, sh, sh, sh),
+                out_shardings=rep,
+            )
+            self._msgs_fn = jax.jit(
+                _verify_cached_msgs,
+                in_shardings=(rep, rep, sh, sh, sh, sh, sh, sh),
+                out_shardings=rep,
+            )
+            build_small = jax.jit(
+                ed25519_batch.neg_pubkey_table,
+                in_shardings=(sh,),
+                out_shardings=(rep, rep),
+            )
+            build_big = jax.jit(
                 ed25519_batch.neg_pubkey_bigtable,
                 in_shardings=(sh,),
                 out_shardings=(rep, rep),
             )
             self._nshards = mesh.devices.size
-        # validator table cache (pubkey bytes -> row in the device array).
-        # Guarded by a lock: the vote micro-batcher calls verify() from an
-        # executor thread while the event-loop thread verifies serially.
-        # The store is allocated lazily and grows in power-of-two rows so
-        # idle verifiers cost nothing (512 KiB per row).
-        self._cache_lock = threading.Lock()
-        self._cache_capacity = table_cache_capacity
-        self._cache_idx: dict[bytes, int] = {}
-        self._tables: jnp.ndarray | None = None
-        self._tables_valid: jnp.ndarray | None = None
-
-    def _grow_store(self, needed_rows: int) -> None:
-        """Ensure the device store has >= needed_rows rows (lock held)."""
-        rows = _TABLE_ROWS_MIN
-        while rows < needed_rows:
-            rows *= 2
-        rows = min(rows, max(1, self._cache_capacity))
-        cur = 0 if self._tables is None else self._tables.shape[0]
-        if rows <= cur:
-            return
-        tables = jnp.zeros((rows, 64, 16, 4, 32), dtype=jnp.int32)
-        valid = jnp.zeros(rows, dtype=bool)
-        if cur:
-            tables = tables.at[:cur].set(self._tables)
-            valid = valid.at[:cur].set(self._tables_valid)
-        self._tables, self._tables_valid = tables, valid
+        # independent locks: a big-tier build (seconds of device work for a
+        # bulk replay) must not stall small-tier vote-path verifies
+        self._small = _TableCache(
+            threading.Lock(),
+            build_small,
+            (16, 4, 32),
+            table_cache_capacity,
+            self._nshards,
+        )
+        self._big = _TableCache(
+            threading.Lock(),
+            build_big,
+            (64, 16, 4, 32),
+            table_cache_capacity,
+            self._nshards,
+        )
 
     # --- table cache -------------------------------------------------------
 
-    def warm(self, pubkeys: list[bytes]) -> None:
-        """Pre-build tables for a validator set (e.g. at height change)."""
-        self._ensure_tables(
-            [pk for pk in pubkeys if len(pk) == 32]
-        )
+    def warm(
+        self,
+        pubkeys: list[bytes],
+        bulk: bool = False,
+        key_types: list[str] | None = None,
+    ) -> None:
+        """Pre-build tables for a validator set (e.g. at height change).
+        bulk=True also warms the big (fixed-window) tier ahead of a known
+        replay workload so its one-time build cost lands here.
 
-    def _ensure_tables(self, pubkeys: list[bytes]) -> bool:
-        """Build + install tables for unseen pubkeys (thread-safe). The
-        cache resets when full (validator rotation must not silently
-        degrade the hot path forever); the next batches repopulate it."""
-        with self._cache_lock:
-            new = []
-            seen = set()
-            for pk in pubkeys:
-                if pk not in self._cache_idx and pk not in seen:
-                    seen.add(pk)
-                    new.append(pk)
-            if not new:
-                return True
-            if len(self._cache_idx) + len(new) > self._cache_capacity:
-                # reset: every unique pubkey in THIS batch must be rebuilt
-                # (previously-cached ones lose their rows in the wipe)
-                uniq = list(dict.fromkeys(pubkeys))
-                if len(uniq) > self._cache_capacity:
-                    return False  # batch alone exceeds capacity
-                self._cache_idx.clear()
-                if self._tables_valid is not None:
-                    self._tables_valid = jnp.zeros_like(self._tables_valid)
-                new = uniq
-            self._grow_store(len(self._cache_idx) + len(new))
-            # chunked builds: a fixed-window table is 512 KiB, so building
-            # thousands of keys at once would transiently hold GiBs
-            for lo in range(0, len(new), 512):
-                chunk = new[lo : lo + 512]
-                b = _bucket(len(chunk), multiple_of=self._nshards)
-                arr = np.zeros((b, 32), dtype=np.uint8)
-                for i, pk in enumerate(chunk):
-                    arr[i] = np.frombuffer(pk, dtype=np.uint8)
-                tables, valid = self._build_fn(jnp.asarray(arr))
-                rows = []
-                for pk in chunk:
-                    row = len(self._cache_idx)
-                    self._cache_idx[pk] = row
-                    rows.append(row)
-                rows_j = jnp.asarray(np.asarray(rows, dtype=np.int32))
-                self._tables = self._tables.at[rows_j].set(
-                    tables[: len(chunk)]
-                )
-                self._tables_valid = self._tables_valid.at[rows_j].set(
-                    valid[: len(chunk)]
-                )
-            return True
+        key_types (aligned with pubkeys) filters to ed25519 rows; without
+        it the 32-byte length heuristic is used, which cannot distinguish
+        sr25519 ristretto encodings — pass types for mixed sets so garbage
+        tables are never built for non-edwards keys."""
+        if key_types is not None:
+            eds = [
+                pk
+                for pk, t in zip(pubkeys, key_types)
+                if t == "ed25519" and len(pk) == 32
+            ]
+        else:
+            eds = [pk for pk in pubkeys if len(pk) == 32]
+        self._small.ensure(eds)
+        if bulk:
+            self._big.ensure(eds)
 
     # --- verification ------------------------------------------------------
 
@@ -247,60 +355,105 @@ class BatchVerifier:
                 dtype=bool,
             )
         b = _bucket(n, multiple_of=self._nshards)
+        big = b >= self._bigtable_min
+        device_hash = (
+            big
+            and self._device_challenge_min is not None
+            and n >= self._device_challenge_min
+            # one oversized message would pad EVERY row's hash buffer to
+            # its length class (pad_messages pads batch-wide); cap the
+            # device-hash path at 2 KiB messages — vote/commit sign-bytes
+            # are ~200 bytes, so the cap only excludes pathological rows
+            and all(
+                len(it.msg) + 64 <= 2048
+                for it in items
+                if len(it.pubkey) == 32 and len(it.sig) == 64
+            )
+        )
         rb = np.zeros((b, 32), dtype=np.uint8)
         sb = np.zeros((b, 32), dtype=np.uint8)
-        kb = np.zeros((b, 32), dtype=np.uint8)
+        kb = None if device_hash else np.zeros((b, 32), dtype=np.uint8)
+        msgs = [b""] * b if device_hash else None
+        prefixes = [b""] * b if device_hash else None
         s_ok = np.zeros(b, dtype=bool)
         well_formed = []
         for i, it in enumerate(items):
             if len(it.pubkey) != 32 or len(it.sig) != 64:
                 continue  # leave row zeroed; s_ok stays False -> reject
             r, s = it.sig[:32], it.sig[32:]
-            k = challenge(r, it.pubkey, it.msg)
+            if device_hash:
+                # challenge k = SHA-512(R||A||M) computed on device, fused
+                # into the verify program (bulk-replay path)
+                msgs[i] = it.msg
+                prefixes[i] = r + it.pubkey
+            else:
+                k = challenge(r, it.pubkey, it.msg)
+                kb[i] = np.frombuffer(
+                    k.to_bytes(32, "little"), dtype=np.uint8
+                )
             rb[i] = np.frombuffer(r, dtype=np.uint8)
             sb[i] = np.frombuffer(s, dtype=np.uint8)
-            kb[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
             s_ok[i] = int.from_bytes(s, "little") < L
             well_formed.append(i)
 
         if not well_formed:
             # nothing to verify on device (malformed pubkey/sig lengths);
-            # also keeps the lazy table store untouched
+            # also keeps the lazy table stores untouched
             return np.zeros(n, dtype=bool)
 
-        # Two attempts: a concurrent verify() can trigger the cache-reset
-        # path between our _ensure_tables and the index read, evicting our
-        # rows; on a second miss fall through to the generic path rather
-        # than mis-rejecting (or crashing on) valid signatures.
-        for _ in range(2):
-            if not self._ensure_tables(
-                [items[i].pubkey for i in well_formed]
-            ):
-                break  # cache cannot hold this batch: generic path
-            with self._cache_lock:
-                tables, tvalid = self._tables, self._tables_valid
-                idx = np.full(b, -1, dtype=np.int32)
-                evicted = False
-                for i in well_formed:
-                    row = self._cache_idx.get(items[i].pubkey)
-                    if row is None:
-                        evicted = True
-                        break
-                    idx[i] = row
-            if evicted:
-                continue
-            out = self._cached_fn(
-                tables,
-                tvalid,
-                jnp.asarray(idx),
-                rb,
-                sb,
-                kb,
-                jnp.asarray(s_ok),
+        if device_hash:
+            from ..ops import sha512 as dev_sha512
+
+            msg_buf, n_blocks = dev_sha512.pad_messages(
+                msgs, prefix_pairs=prefixes
             )
+
+        cache = self._big if big else self._small
+        row_pubkeys = [(i, items[i].pubkey) for i in well_formed]
+        # Two attempts: a concurrent verify() can trigger the cache-reset
+        # path between ensure() and snapshot(), evicting our rows; on a
+        # second miss fall through to the generic path rather than
+        # mis-rejecting (or crashing on) valid signatures.
+        for _ in range(2):
+            if not cache.ensure([pk for _, pk in row_pubkeys]):
+                break  # cache cannot hold this batch: generic path
+            snap = cache.snapshot(row_pubkeys, b)
+            if snap is None:
+                continue
+            tables, tvalid, idx = snap
+            if device_hash:
+                out = self._msgs_fn(
+                    tables,
+                    tvalid,
+                    jnp.asarray(idx),
+                    rb,
+                    sb,
+                    jnp.asarray(msg_buf),
+                    jnp.asarray(n_blocks),
+                    jnp.asarray(s_ok),
+                )
+            elif big:
+                out = self._big_fn(
+                    tables, tvalid, jnp.asarray(idx), rb, sb, kb,
+                    jnp.asarray(s_ok),
+                )
+            else:
+                out = self._small_fn(
+                    tables, tvalid, jnp.asarray(idx), rb, sb, kb,
+                    jnp.asarray(s_ok),
+                )
             return np.asarray(out)[:n]
 
-        # cache full: generic path (decompress in-batch)
+        # cache full: generic path (decompress in-batch; host challenges —
+        # this fallback is the validator-churn edge, not the bulk path)
+        if kb is None:
+            kb = np.zeros((b, 32), dtype=np.uint8)
+            for i in well_formed:
+                it = items[i]
+                k = challenge(it.sig[:32], it.pubkey, it.msg)
+                kb[i] = np.frombuffer(
+                    k.to_bytes(32, "little"), dtype=np.uint8
+                )
         pub = np.zeros((b, 32), dtype=np.uint8)
         for i in well_formed:
             pub[i] = np.frombuffer(items[i].pubkey, dtype=np.uint8)

@@ -11,7 +11,7 @@ Validated against the RFC 9496 §A small-multiples-of-B vectors
 
 from __future__ import annotations
 
-from .ed25519 import D, P, Point, point_add, point_equal, scalar_mult
+from .ed25519 import D, P, Point
 
 SQRT_M1 = pow(2, (P - 1) // 4, P)
 # 1 / sqrt(a - d) with a = -1 (constant from RFC 9496 §4.1)

@@ -27,14 +27,13 @@ import hashlib
 from dataclasses import dataclass
 
 from . import ristretto
-from .ed25519 import BX, BY, L, P, point_add, point_neg, scalar_mult
+from .ed25519 import BASEPOINT as _BASEPOINT
+from .ed25519 import L, point_add, point_neg, scalar_mult
 from .merlin import Transcript
 
 KEY_TYPE = "sr25519"
 PUB_KEY_SIZE = 32
 SIGNATURE_SIZE = 64
-
-_BASEPOINT = (BX, BY, 1, BX * BY % P)
 
 
 def _signing_context(msg: bytes) -> Transcript:

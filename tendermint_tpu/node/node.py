@@ -357,6 +357,10 @@ class Node(Service):
             and not os.environ.get("TM_TPU_SKIP_WARM")
         ):
             pubs = [v.pub_key.data for v in vals.validators]
+            ktypes = [
+                getattr(v.pub_key, "type_name", "ed25519")
+                for v in vals.validators
+            ]
             # daemon thread: the build may include a device compile and
             # must neither block the event loop nor delay shutdown
             import threading as _threading
@@ -364,6 +368,7 @@ class Node(Service):
             _threading.Thread(
                 target=self.consensus.verifier.warm,
                 args=(pubs,),
+                kwargs={"key_types": ktypes},
                 daemon=True,
                 name="verifier-warm",
             ).start()

@@ -105,5 +105,27 @@ def verify_prehashed_bigcache(
     return table_valid & s_ok & r_match
 
 
+def verify_msgs_bigcache(
+    tables_cache: jnp.ndarray,  # [cap, 64, 16, 4, 32] shared table cache
+    table_valid: jnp.ndarray,  # [B] bool
+    idx: jnp.ndarray,  # [B] int32 row index into the cache
+    r_bytes: jnp.ndarray,  # [B, 32] uint8
+    s_bytes: jnp.ndarray,  # [B, 32] uint8
+    msg_buf: jnp.ndarray,  # [B, NBLK*128] uint8 prepadded R||A||M
+    n_blocks: jnp.ndarray,  # [B] int32 SHA-512 block counts
+    s_ok: jnp.ndarray,  # [B] bool
+) -> jnp.ndarray:
+    """Fully-fused bulk path: the challenge k = SHA-512(R||A||M) mod L is
+    computed on device (ops/sha512.challenge_batch) instead of on one host
+    thread — the bulk-replay shape (SURVEY.md §3.4) where per-sig host
+    hashing would cap throughput."""
+    from . import sha512
+
+    k_bytes = sha512.challenge_batch(msg_buf, n_blocks)
+    return verify_prehashed_bigcache(
+        tables_cache, table_valid, idx, r_bytes, s_bytes, k_bytes, s_ok
+    )
+
+
 verify_prehashed_jit = jax.jit(verify_prehashed)
 verify_prehashed_table_jit = jax.jit(verify_prehashed_table)

@@ -194,13 +194,20 @@ def sha512_batch(data: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarray:
 def pad_messages(msgs: list[bytes], prefix_pairs=None) -> tuple:
     """Host helper: SHA-512 pad each message into one [B, NBLK*128]
     buffer + [B] block counts. `prefix_pairs[i]` (optional bytes) is
-    prepended to msgs[i] — the verify path passes R||A per row."""
+    prepended to msgs[i] — the verify path passes R||A per row.
+
+    NBLK is bucketed to a power of two so the fused verify program
+    compiles for a handful of shapes, not one per max-length class
+    (shape discipline as in crypto/batch_verifier.BUCKETS)."""
     full = [
         (prefix_pairs[i] if prefix_pairs else b"") + m
         for i, m in enumerate(msgs)
     ]
     lens = [len(f) for f in full]
-    nblk = max(1, max((l + 17 + 127) // 128 for l in lens))
+    needed = max(1, max((l + 17 + 127) // 128 for l in lens))
+    nblk = 1
+    while nblk < needed:
+        nblk *= 2
     buf = np.zeros((len(full), nblk * 128), dtype=np.uint8)
     counts = np.zeros(len(full), dtype=np.int32)
     for i, f in enumerate(full):

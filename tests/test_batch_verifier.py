@@ -119,6 +119,29 @@ def test_mixed_key_types_partition():
     assert got.tolist() == want
 
 
+def test_device_challenge_path_matches_oracle():
+    """device_challenge_min=0 forces SHA-512 challenges on device (the
+    fused bulk-replay path); results must match the host oracle exactly,
+    including rejects."""
+    v = BatchVerifier(
+        min_device_batch=0, device_challenge_min=0, bigtable_min=0
+    )
+    keys = _keypairs(9)
+    items, want = [], []
+    for i, k in enumerate(keys):
+        msg = (b"bulk-%d " % i) * (i + 1)  # ragged lengths
+        sig = k.sign(msg)
+        if i % 3 == 1:
+            msg = msg + b"?"  # tamper after signing
+        if i % 3 == 2:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]  # corrupt R
+        items.append(SigItem(k.public_key().data, msg, sig))
+        want.append(host.verify(items[-1].pubkey, msg, items[-1].sig))
+    got = v.verify(items)
+    assert got.tolist() == want
+    assert any(want) and not all(want)
+
+
 def test_malformed_only_batch_rejects():
     """A device-size batch with zero well-formed rows returns all-False
     (no crash on the lazily-allocated table store)."""

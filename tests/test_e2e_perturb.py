@@ -32,7 +32,7 @@ def test_node_kill_and_rejoin_recovers():
         for cs, *_ in nodes:
             await cs.start()
         await asyncio.gather(
-            *(cs.wait_for_height(2, timeout=60) for cs, *_ in nodes)
+            *(cs.wait_for_height(2, timeout=150) for cs, *_ in nodes)
         )
 
         # perturb: kill node 3 entirely (consensus + switch)
@@ -44,7 +44,7 @@ def test_node_kill_and_rejoin_recovers():
         survivors = nodes[:3]
         target = max(cs.rs.height for cs, *_ in survivors) + 2
         await asyncio.gather(
-            *(cs.wait_for_height(target, timeout=60) for cs, *_ in survivors)
+            *(cs.wait_for_height(target, timeout=150) for cs, *_ in survivors)
         )
 
         # rejoin: fresh p2p node, same privval + stores (restart semantics)
@@ -83,7 +83,7 @@ def test_node_kill_and_rejoin_recovers():
 
         # the rejoined node catches up past the survivors' progress
         catchup_target = max(cs.rs.height for cs, *_ in survivors) + 1
-        await dead_cs.wait_for_height(catchup_target, timeout=60)
+        await dead_cs.wait_for_height(catchup_target, timeout=150)
 
         # all four agree on the chain
         h = min(
@@ -155,7 +155,7 @@ def test_consensus_survives_lossy_links():
             for cs, *_ in nodes:
                 await cs.start()
             await asyncio.gather(
-                *(cs.wait_for_height(3, timeout=90) for cs, *_ in nodes)
+                *(cs.wait_for_height(3, timeout=180) for cs, *_ in nodes)
             )
             hashes = {
                 cs.block_store.load_block(3).hash() for cs, *_ in nodes

@@ -7,6 +7,14 @@ import jax.numpy as jnp
 
 from tendermint_tpu.ops import field25519 as fe
 
+import functools
+import jax
+
+
+@functools.cache
+def _j(f):
+    return jax.jit(f)
+
 P = fe.P
 rng = np.random.default_rng(1234)
 
@@ -40,35 +48,35 @@ def assert_loose(x):
 
 
 def test_roundtrip():
-    assert unpack_canonical(fe.canonical(A)) == [a % P for a in A_INTS]
+    assert unpack_canonical(_j(fe.canonical)(A)) == [a % P for a in A_INTS]
 
 
 def test_add():
-    out = fe.add(A, B)
+    out = _j(fe.add)(A, B)
     assert_loose(out)
-    assert unpack_canonical(fe.canonical(out)) == [
+    assert unpack_canonical(_j(fe.canonical)(out)) == [
         (a + b) % P for a, b in zip(A_INTS, B_INTS)
     ]
 
 
 def test_sub():
-    out = fe.sub(A, B)
+    out = _j(fe.sub)(A, B)
     assert_loose(out)
-    assert unpack_canonical(fe.canonical(out)) == [
+    assert unpack_canonical(_j(fe.canonical)(out)) == [
         (a - b) % P for a, b in zip(A_INTS, B_INTS)
     ]
 
 
 def test_neg():
-    out = fe.neg(A)
+    out = _j(fe.neg)(A)
     assert_loose(out)
-    assert unpack_canonical(fe.canonical(out)) == [(-a) % P for a in A_INTS]
+    assert unpack_canonical(_j(fe.canonical)(out)) == [(-a) % P for a in A_INTS]
 
 
 def test_mul():
-    out = fe.mul(A, B)
+    out = _j(fe.mul)(A, B)
     assert_loose(out)
-    assert unpack_canonical(fe.canonical(out)) == [
+    assert unpack_canonical(_j(fe.canonical)(out)) == [
         (a * b) % P for a, b in zip(A_INTS, B_INTS)
     ]
 
@@ -77,9 +85,9 @@ def test_mul_loose_inputs():
     # worst-case loose inputs: all limbs 511
     x = jnp.full((4, 32), 511, dtype=jnp.int32)
     xv = fe.to_int(np.full(32, 511, dtype=np.int64)) % P
-    out = fe.mul(x, x)
+    out = _j(fe.mul)(x, x)
     assert_loose(out)
-    assert unpack_canonical(fe.canonical(out)) == [(xv * xv) % P] * 4
+    assert unpack_canonical(_j(fe.canonical)(out)) == [(xv * xv) % P] * 4
 
 
 def test_sqr_chain():
@@ -87,23 +95,23 @@ def test_sqr_chain():
     x = A
     ref = list(A_INTS)
     for _ in range(8):
-        x = fe.sqr(x)
+        x = _j(fe.sqr)(x)
         ref = [(v * v) % P for v in ref]
         assert_loose(x)
-    assert unpack_canonical(fe.canonical(x)) == ref
+    assert unpack_canonical(_j(fe.canonical)(x)) == ref
 
 
 def test_mul_small():
     out = fe.mul_small(A, 121666)
     assert_loose(out)
-    assert unpack_canonical(fe.canonical(out)) == [
+    assert unpack_canonical(_j(fe.canonical)(out)) == [
         (a * 121666) % P for a in A_INTS
     ]
 
 
 def test_invert():
-    out = fe.invert(A)
-    got = unpack_canonical(fe.canonical(out))
+    out = _j(fe.invert)(A)
+    got = unpack_canonical(_j(fe.canonical)(out))
     for a, g in zip(A_INTS, got):
         if a == 0:
             assert g == 0
@@ -112,8 +120,8 @@ def test_invert():
 
 
 def test_pow22523():
-    out = fe.pow22523(A)
-    got = unpack_canonical(fe.canonical(out))
+    out = _j(fe.pow22523)(A)
+    got = unpack_canonical(_j(fe.canonical)(out))
     for a, g in zip(A_INTS, got):
         assert g == pow(a, (P - 5) // 8, P)
 
@@ -127,20 +135,20 @@ def test_canonical_edge_values(v):
     limbs = np.array(
         [int(b) for b in (v % 2**256).to_bytes(32, "little")], dtype=np.int32
     )
-    out = fe.canonical(jnp.asarray(limbs)[None])
+    out = _j(fe.canonical)(jnp.asarray(limbs)[None])
     assert unpack_canonical(out) == [(v % 2**256) % P]
 
 
 def test_eq_and_parity():
-    assert bool(np.asarray(fe.eq(A, A)).all())
-    assert not bool(np.asarray(fe.eq(A, B)).any())
-    par = np.asarray(fe.parity(A))
+    assert bool(np.asarray(_j(fe.eq)(A, A)).all())
+    assert not bool(np.asarray(_j(fe.eq)(A, B)).any())
+    par = np.asarray(_j(fe.parity)(A))
     assert par.tolist() == [a % 2 for a in A_INTS]
 
 
 def test_select():
     cond = jnp.asarray([True, False] * (N // 2))
     out = fe.select(cond, A, B)
-    got = unpack_canonical(fe.canonical(out))
+    got = unpack_canonical(_j(fe.canonical)(out))
     want = [a if i % 2 == 0 else b for i, (a, b) in enumerate(zip(A_INTS, B_INTS))]
     assert got == [w % P for w in want]

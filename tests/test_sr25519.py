@@ -5,10 +5,9 @@ import numpy as np
 import pytest
 
 from tendermint_tpu.crypto import ristretto, sr25519
-from tendermint_tpu.crypto.ed25519 import BX, BY, P, point_add, scalar_mult
+from tendermint_tpu.crypto.ed25519 import BASEPOINT as B
+from tendermint_tpu.crypto.ed25519 import P, point_add, scalar_mult
 from tendermint_tpu.crypto.merlin import Transcript
-
-B = (BX, BY, 1, BX * BY % P)
 
 
 def test_merlin_conformance_vector():

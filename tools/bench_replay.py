@@ -31,7 +31,7 @@ def main():
     n_sigs = BLOCKS * VALS
 
     verifier = BatchVerifier()
-    verifier.warm([v.pub_key.data for v in vs.validators])
+    verifier.warm([v.pub_key.data for v in vs.validators], bulk=True)
 
     # warm the jit for this batch bucket
     verdicts = vs.verify_commits_light(CHAIN_ID, entries, verifier=verifier)
