@@ -42,6 +42,9 @@ class RPCConfig:
     max_subscriptions_per_client: int = 5
     timeout_broadcast_tx_commit: float = 10.0
     pprof_laddr: str = ""
+    # expose the unsafe routes (dial_seeds/dial_peers — reference
+    # rpc/core/routes.go:46-50); off by default like the reference
+    unsafe: bool = False
 
     def validate_basic(self) -> None:
         if self.max_open_connections < 0:

@@ -162,9 +162,13 @@ def double(p: jnp.ndarray) -> jnp.ndarray:
 
 
 def compress(p: jnp.ndarray) -> jnp.ndarray:
-    """Canonical 32-byte encoding: y with the sign(x) bit on top. [..., 32] u8."""
+    """Canonical 32-byte encoding: y with the sign(x) bit on top. [..., 32] u8.
+
+    For a plain batch of points ([B, 4, 32]) the Z inversions use
+    Montgomery's trick (`fe.invert_many`): one Fermat inversion for the
+    whole batch instead of one per element."""
     x, y, z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
-    zinv = fe.invert(z)
+    zinv = fe.invert_many(z) if p.ndim == 3 else fe.invert(z)
     xy = fe.mul(jnp.stack([x, y], axis=-2), zinv[..., None, :])
     xa = fe.canonical(xy[..., 0, :])
     ya = fe.canonical(xy[..., 1, :])

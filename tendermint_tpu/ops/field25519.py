@@ -192,6 +192,28 @@ def pow22523(z: jnp.ndarray) -> jnp.ndarray:
     return mul(_sqr_n(z2_250_0, 2), z)
 
 
+def invert_many(z: jnp.ndarray) -> jnp.ndarray:
+    """Batched inversion of [B, 32] via Montgomery's trick.
+
+    Parallel prefix/suffix product scans + ONE Fermat inversion of the
+    total product: inv(z_i) = prefix_{i-1} * suffix_{i+1} * inv(total).
+    ~7 batch-muls of work instead of the 265 of per-element `invert`
+    (the compress stage's cost drops accordingly). Rows equal to zero
+    invert to 0, matching `invert` — and are masked to 1 inside the
+    product chain so one zero row cannot poison the whole batch.
+    """
+    zero_mask = is_zero(z)
+    safe = select(zero_mask, ones(z.shape[:-1]), z)
+    prefix = jax.lax.associative_scan(mul, safe, axis=0)
+    suffix = jax.lax.associative_scan(mul, safe, axis=0, reverse=True)
+    total_inv = invert(prefix[-1])
+    one_row = ones((1,))
+    excl_p = jnp.concatenate([one_row, prefix[:-1]], axis=0)
+    excl_s = jnp.concatenate([suffix[1:], one_row], axis=0)
+    inv = mul(mul(excl_p, excl_s), jnp.broadcast_to(total_inv, z.shape))
+    return select(zero_mask, zeros(z.shape[:-1]), inv)
+
+
 def _scan_carry(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Exact sequential carry over the limb axis (no wrap).
 

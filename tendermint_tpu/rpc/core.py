@@ -33,6 +33,9 @@ class RPCCore:
             "net_info": self.net_info,
             "blockchain": self.blockchain,
             "genesis": self.genesis,
+            "genesis_chunked": self.genesis_chunked,
+            "header": self.header,
+            "header_by_hash": self.header_by_hash,
             "block": self.block,
             "block_by_hash": self.block_by_hash,
             "block_results": self.block_results,
@@ -51,6 +54,15 @@ class RPCCore:
             "broadcast_evidence": self.broadcast_evidence,
             # help
             "help": lambda: {"routes": sorted(self.routes())},
+            # unsafe (gated on config rpc.unsafe, reference routes.go:46-50)
+            **(
+                {
+                    "dial_seeds": self.dial_seeds,
+                    "dial_peers": self.dial_peers,
+                }
+                if getattr(self.node.config.rpc, "unsafe", False)
+                else {}
+            ),
         }
 
     # --- handlers ------------------------------------------------------------
@@ -126,6 +138,70 @@ class RPCCore:
 
     def genesis(self) -> dict:
         return {"genesis": self.node.genesis.to_json()}
+
+    def dial_seeds(self, seeds=None, **_kw) -> dict:
+        """Unsafe: dial the given seed addresses (reference routes.go:48)."""
+        return self._dial(seeds or [], persistent=False)
+
+    def dial_peers(self, peers=None, persistent=False, **_kw) -> dict:
+        """Unsafe: dial the given peer addresses (reference routes.go:49)."""
+        return self._dial(peers or [], persistent=bool(persistent))
+
+    def _dial(self, addrs, persistent: bool) -> dict:
+        from ..p2p.transport import NetAddress
+
+        if isinstance(addrs, str):
+            addrs = [a for a in addrs.split(",") if a]
+        parsed = [NetAddress.parse(a) for a in addrs]
+        self.node.switch.dial_peers_async(parsed, persistent=persistent)
+        return {"log": f"dialing {len(parsed)} addresses"}
+
+    def genesis_chunked(self, chunk=None, **_kw) -> dict:
+        """Genesis split into base64 chunks (reference rpc/core/net.go
+        GenesisChunked; routes.go:22) for large genesis documents."""
+        import base64
+        import json as _json
+
+        data = _json.dumps(self.node.genesis.to_json()).encode()
+        size = 16 * 1024
+        chunks = [data[i : i + size] for i in range(0, len(data), size)] or [
+            b""
+        ]
+        idx = int(chunk) if chunk is not None else 0
+        if not (0 <= idx < len(chunks)):
+            from .server import RPCError
+
+            raise RPCError(
+                -32000,
+                f"chunk {idx} out of range (total {len(chunks)})",
+            )
+        return {
+            "chunk": idx,
+            "total": len(chunks),
+            "data": base64.b64encode(chunks[idx]).decode(),
+        }
+
+    def header(self, height=None, **_kw) -> dict:
+        """Block header only (reference routes.go:27)."""
+        bs = self.node.block_store
+        h = int(height) if height else bs.height
+        meta = bs.load_block_meta(h)
+        if meta is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, f"no header at height {h}")
+        return {"header": self._header_json(meta.header)}
+
+    def header_by_hash(self, hash=None, **_kw) -> dict:
+        """Block header by block hash (reference routes.go:28)."""
+        bs = self.node.block_store
+        h_bytes = bytes.fromhex(hash) if hash else b""
+        blk = bs.load_block_by_hash(h_bytes)
+        if blk is None:
+            from .server import RPCError
+
+            raise RPCError(-32000, "header not found")
+        return {"header": self._header_json(blk.header)}
 
     def block(self, height=None, **_kw) -> dict:
         bs = self.node.block_store

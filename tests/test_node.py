@@ -92,6 +92,31 @@ def test_node_runs_chain_and_serves_rpc(tmp_path):
         cp = await rpc.call("consensus_params")
         assert cp["consensus_params"]["evidence"]["max_age_num_blocks"] > 0
 
+        hdr = await rpc.call("header", height=2)
+        assert hdr["header"]["height"] == 2
+
+        hdr2 = await rpc.call("header_by_hash", hash=got_hash)
+        assert hdr2["header"]["height"] == 2
+
+        gc = await rpc.call("genesis_chunked", chunk=0)
+        assert gc["chunk"] == 0 and gc["total"] >= 1
+        import base64 as _b64
+        import json as _json
+
+        joined = b""
+        for i in range(gc["total"]):
+            part = await rpc.call("genesis_chunked", chunk=i)
+            joined += _b64.b64decode(part["data"])
+        assert _json.loads(joined)["chain_id"] == node.genesis.chain_id
+
+        # unsafe routes are absent unless rpc.unsafe is set
+        try:
+            await rpc.call("dial_peers", peers=[])
+            raised = False
+        except Exception:
+            raised = True
+        assert raised
+
         await node.stop()
 
     asyncio.run(run())

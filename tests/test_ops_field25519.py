@@ -152,3 +152,12 @@ def test_select():
     got = unpack_canonical(_j(fe.canonical)(out))
     want = [a if i % 2 == 0 else b for i, (a, b) in enumerate(zip(A_INTS, B_INTS))]
     assert got == [w % P for w in want]
+
+
+def test_invert_many_matches_invert():
+    vals = rand_ints(9)
+    vals[3] = 0  # zero row must invert to 0 without poisoning the batch
+    x = pack(vals)
+    got = unpack_canonical(_j(fe.canonical)(_j(fe.invert_many)(x)))
+    want = [pow(v, P - 2, P) if v else 0 for v in vals]
+    assert got == want
