@@ -1,0 +1,1129 @@
+// BLS12-381 pairing + group arithmetic — native host fast path.
+//
+// The framework's from-scratch pure-Python implementation
+// (tendermint_tpu/crypto/bls12_381.py) is the algorithmic spec; this file
+// re-implements the exact same construction in C++ for host speed (the
+// reference uses Go kilic/bls12-381 for the per-precommit verify,
+// blssignatures/bls_signatures.go:110-127 — this is the tpu framework's
+// native equivalent, SURVEY.md §7.1):
+//
+//   Fp       6x64-bit limbs, Montgomery form (CIOS multiplication)
+//   Fp2      c0 + c1*u, u^2 = -1
+//   Fp12     flat sextic Fp2[w]/(w^6 - XI), XI = 1+u  (same tower as the
+//            Python impl; NOT the 2-3-2 tower kilic/blst use)
+//   G1/G2    Jacobian; Miller loop over affine T with extgcd inversion
+//   pairing  optimal ate, x = -0xD201000000010000, final exp via the
+//            (x-1)^2 (x+p) (x^2+p^2-1) + 3 chain (cube of the ate pairing,
+//            still bilinear/non-degenerate — see python module docstring)
+//
+// ABI: wire-format bytes in/out (G1 = x||y 96B BE, G2 = x1||x0||y1||y0
+// 192B BE, scalars 32B BE, all-zero point = infinity), matching
+// crypto/bls_signatures.py serialization. All functions return 1 ok /
+// 0 false / -1 malformed input.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+typedef unsigned __int128 u128;
+
+struct fp { uint64_t l[6]; };
+
+static const fp FP_P = {{0xb9feffffffffaaabull, 0x1eabfffeb153ffffull, 0x6730d2a0f6b0f624ull, 0x64774b84f38512bfull, 0x4b1ba7b6434bacd7ull, 0x1a0111ea397fe69aull}};
+static const fp FP_R2 = {{0xf4df1f341c341746ull, 0x0a76e6a609d104f1ull, 0x8de5476c4c95b6d5ull, 0x67eb88a9939d83c0ull, 0x9a793e85b519952dull, 0x11988fe592cae3aaull}};
+static const fp FP_ONE_MONT = {{0x760900000002fffdull, 0xebf4000bc40c0002ull, 0x5f48985753c758baull, 0x77ce585370525745ull, 0x5c071a97a256ec6dull, 0x15f65ec3fa80e493ull}};
+static const uint64_t FP_N0 = 0x89f3fffcfffcfffdull;
+static const fp FP_ZERO = {{0, 0, 0, 0, 0, 0}};
+// group order r (plain limbs, little-endian)
+static const uint64_t FR_R[4] = {0xffffffff00000001ull, 0x53bda402fffe5bfeull, 0x3339d80809a1d805ull, 0x73eda753299d7d48ull};
+static const uint64_t X_ABS = 0xD201000000010000ull; // |x|; x is negative
+
+// --- Fp ------------------------------------------------------------------
+
+static inline bool fp_is_zero(const fp &a) {
+    uint64_t z = 0;
+    for (int i = 0; i < 6; i++) z |= a.l[i];
+    return z == 0;
+}
+
+static inline bool fp_eq(const fp &a, const fp &b) {
+    uint64_t z = 0;
+    for (int i = 0; i < 6; i++) z |= a.l[i] ^ b.l[i];
+    return z == 0;
+}
+
+// a >= b on raw limbs
+static inline bool fp_geq(const fp &a, const fp &b) {
+    for (int i = 5; i >= 0; i--) {
+        if (a.l[i] > b.l[i]) return true;
+        if (a.l[i] < b.l[i]) return false;
+    }
+    return true;
+}
+
+// out = a + b (raw), returns carry
+static inline uint64_t fp_add_raw(fp &out, const fp &a, const fp &b) {
+    u128 c = 0;
+    for (int i = 0; i < 6; i++) {
+        c += (u128)a.l[i] + b.l[i];
+        out.l[i] = (uint64_t)c;
+        c >>= 64;
+    }
+    return (uint64_t)c;
+}
+
+// out = a - b (raw), returns borrow
+static inline uint64_t fp_sub_raw(fp &out, const fp &a, const fp &b) {
+    u128 brw = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 d = (u128)a.l[i] - b.l[i] - brw;
+        out.l[i] = (uint64_t)d;
+        brw = (d >> 64) & 1;
+    }
+    return (uint64_t)brw;
+}
+
+static inline void fp_add(fp &out, const fp &a, const fp &b) {
+    uint64_t carry = fp_add_raw(out, a, b);
+    if (carry || fp_geq(out, FP_P)) {
+        fp t;
+        fp_sub_raw(t, out, FP_P);
+        out = t;
+    }
+}
+
+static inline void fp_sub(fp &out, const fp &a, const fp &b) {
+    if (fp_sub_raw(out, a, b)) {
+        fp t;
+        fp_add_raw(t, out, FP_P);
+        out = t;
+    }
+}
+
+static inline void fp_neg(fp &out, const fp &a) {
+    if (fp_is_zero(a)) { out = a; return; }
+    fp_sub_raw(out, FP_P, a);
+}
+
+static inline void fp_dbl(fp &out, const fp &a) { fp_add(out, a, a); }
+
+// Montgomery CIOS: out = a*b*R^-1 mod p
+static void fp_mul(fp &out, const fp &a, const fp &b) {
+    uint64_t t[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 6; i++) {
+        u128 c = 0;
+        for (int j = 0; j < 6; j++) {
+            c += (u128)t[j] + (u128)a.l[j] * b.l[i];
+            t[j] = (uint64_t)c;
+            c >>= 64;
+        }
+        c += t[6];
+        t[6] = (uint64_t)c;
+        t[7] = (uint64_t)(c >> 64);
+
+        uint64_t m = t[0] * FP_N0;
+        c = (u128)t[0] + (u128)m * FP_P.l[0];
+        c >>= 64;
+        for (int j = 1; j < 6; j++) {
+            c += (u128)t[j] + (u128)m * FP_P.l[j];
+            t[j - 1] = (uint64_t)c;
+            c >>= 64;
+        }
+        c += t[6];
+        t[5] = (uint64_t)c;
+        t[6] = t[7] + (uint64_t)(c >> 64);
+    }
+    fp r;
+    for (int i = 0; i < 6; i++) r.l[i] = t[i];
+    if (t[6] || fp_geq(r, FP_P)) {
+        fp s;
+        fp_sub_raw(s, r, FP_P);
+        // if t[6] was set the subtraction is exact mod 2^384 (p < 2^381)
+        r = s;
+    }
+    out = r;
+}
+
+static inline void fp_sqr(fp &out, const fp &a) { fp_mul(out, a, a); }
+
+static inline void fp_to_mont(fp &out, const fp &a) { fp_mul(out, a, FP_R2); }
+
+static inline void fp_from_mont(fp &out, const fp &a) {
+    fp one = {{1, 0, 0, 0, 0, 0}};
+    fp_mul(out, a, one);
+}
+
+// helpers for the binary extgcd
+static inline bool limbs_is_one(const fp &a) {
+    return a.l[0] == 1 && !(a.l[1] | a.l[2] | a.l[3] | a.l[4] | a.l[5]);
+}
+
+static inline void limbs_shr1(fp &a, uint64_t top) {
+    for (int i = 0; i < 5; i++) a.l[i] = (a.l[i] >> 1) | (a.l[i + 1] << 63);
+    a.l[5] = (a.l[5] >> 1) | (top << 63);
+}
+
+// a^-1 mod p, normal (non-Montgomery) in and out; a must be nonzero
+static void fp_inv_normal(fp &out, const fp &a) {
+    fp u = a, v = FP_P;
+    fp x1 = {{1, 0, 0, 0, 0, 0}}, x2 = FP_ZERO;
+    while (!limbs_is_one(u) && !limbs_is_one(v)) {
+        while (!(u.l[0] & 1)) {
+            limbs_shr1(u, 0);
+            if (x1.l[0] & 1) {
+                uint64_t c = fp_add_raw(x1, x1, FP_P);
+                limbs_shr1(x1, c);
+            } else {
+                limbs_shr1(x1, 0);
+            }
+        }
+        while (!(v.l[0] & 1)) {
+            limbs_shr1(v, 0);
+            if (x2.l[0] & 1) {
+                uint64_t c = fp_add_raw(x2, x2, FP_P);
+                limbs_shr1(x2, c);
+            } else {
+                limbs_shr1(x2, 0);
+            }
+        }
+        if (fp_geq(u, v)) {
+            fp_sub_raw(u, u, v);
+            fp_sub(x1, x1, x2);
+        } else {
+            fp_sub_raw(v, v, u);
+            fp_sub(x2, x2, x1);
+        }
+    }
+    out = limbs_is_one(u) ? x1 : x2;
+}
+
+// Montgomery in/out: out = a^-1 (so that mont(out) * mont(a) = mont(1))
+static void fp_inv(fp &out, const fp &a) {
+    fp n, i;
+    fp_from_mont(n, a);
+    fp_inv_normal(i, n);
+    // i = a^-1 plain; need Mont form times extra R to cancel: Mont(a)=aR,
+    // want w with mont_mul(w, aR) = R  =>  w = a^-1 * R  = mont_mul(i, R2)...
+    // mont_mul(i, R2) = i*R2/R = a^-1 * R. Correct.
+    fp_mul(out, i, FP_R2);
+}
+
+static int fp_from_bytes(fp &out, const uint8_t *b) {
+    fp n;
+    for (int i = 0; i < 6; i++) {
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++) v = (v << 8) | b[(5 - i) * 8 + j];
+        n.l[i] = v;
+    }
+    if (fp_geq(n, FP_P)) return -1;
+    fp_to_mont(out, n);
+    return 1;
+}
+
+static void fp_to_bytes(uint8_t *b, const fp &a) {
+    fp n;
+    fp_from_mont(n, a);
+    for (int i = 0; i < 6; i++) {
+        uint64_t v = n.l[i];
+        for (int j = 7; j >= 0; j--) {
+            b[(5 - i) * 8 + j] = (uint8_t)v;
+            v >>= 8;
+        }
+    }
+}
+
+// --- Fp2: c0 + c1*u, u^2 = -1 -------------------------------------------
+
+struct fp2 { fp c0, c1; };
+
+static const fp2 F2_ZERO_C = {FP_ZERO, FP_ZERO};
+
+static inline bool f2_is_zero(const fp2 &a) {
+    return fp_is_zero(a.c0) && fp_is_zero(a.c1);
+}
+
+static inline bool f2_eq(const fp2 &a, const fp2 &b) {
+    return fp_eq(a.c0, b.c0) && fp_eq(a.c1, b.c1);
+}
+
+static inline void f2_add(fp2 &o, const fp2 &a, const fp2 &b) {
+    fp_add(o.c0, a.c0, b.c0);
+    fp_add(o.c1, a.c1, b.c1);
+}
+
+static inline void f2_sub(fp2 &o, const fp2 &a, const fp2 &b) {
+    fp_sub(o.c0, a.c0, b.c0);
+    fp_sub(o.c1, a.c1, b.c1);
+}
+
+static inline void f2_neg(fp2 &o, const fp2 &a) {
+    fp_neg(o.c0, a.c0);
+    fp_neg(o.c1, a.c1);
+}
+
+static inline void f2_conj(fp2 &o, const fp2 &a) {
+    o.c0 = a.c0;
+    fp_neg(o.c1, a.c1);
+}
+
+// Karatsuba: 3 fp muls
+static void f2_mul(fp2 &o, const fp2 &a, const fp2 &b) {
+    fp t0, t1, s0, s1, m;
+    fp_mul(t0, a.c0, b.c0);
+    fp_mul(t1, a.c1, b.c1);
+    fp_add(s0, a.c0, a.c1);
+    fp_add(s1, b.c0, b.c1);
+    fp_mul(m, s0, s1);
+    fp_sub(o.c1, m, t0);
+    fp_sub(o.c1, o.c1, t1);
+    fp_sub(o.c0, t0, t1);
+}
+
+static void f2_sqr(fp2 &o, const fp2 &a) {
+    // (a0+a1)(a0-a1), 2*a0*a1
+    fp s, d, m;
+    fp_add(s, a.c0, a.c1);
+    fp_sub(d, a.c0, a.c1);
+    fp_mul(m, a.c0, a.c1);
+    fp_mul(o.c0, s, d);
+    fp_dbl(o.c1, m);
+}
+
+// o = a * (1 + u)  (the tower's XI)
+static inline void f2_mul_xi(fp2 &o, const fp2 &a) {
+    fp t0, t1;
+    fp_sub(t0, a.c0, a.c1);
+    fp_add(t1, a.c0, a.c1);
+    o.c0 = t0;
+    o.c1 = t1;
+}
+
+static inline void f2_scale(fp2 &o, const fp2 &a, const fp &k) {
+    fp_mul(o.c0, a.c0, k);
+    fp_mul(o.c1, a.c1, k);
+}
+
+static void f2_inv(fp2 &o, const fp2 &a) {
+    fp t0, t1, t;
+    fp_sqr(t0, a.c0);
+    fp_sqr(t1, a.c1);
+    fp_add(t, t0, t1);
+    fp_inv(t, t);
+    fp_mul(o.c0, a.c0, t);
+    fp_mul(t, a.c1, t);
+    fp_neg(o.c1, t);
+}
+
+// --- Fp12 = Fp2[w]/(w^6 - XI), flat representation -----------------------
+
+struct fp12 { fp2 c[6]; };
+
+static void f12_one(fp12 &o) {
+    for (int i = 0; i < 6; i++) o.c[i] = F2_ZERO_C;
+    o.c[0].c0 = FP_ONE_MONT;
+}
+
+static bool f12_is_one(const fp12 &a) {
+    if (!fp_eq(a.c[0].c0, FP_ONE_MONT) || !fp_is_zero(a.c[0].c1)) return false;
+    for (int i = 1; i < 6; i++)
+        if (!f2_is_zero(a.c[i])) return false;
+    return true;
+}
+
+// schoolbook over w with w^6 = XI (mirror of python f12_mul)
+static void f12_mul(fp12 &o, const fp12 &a, const fp12 &b) {
+    fp2 acc[11];
+    for (int k = 0; k < 11; k++) acc[k] = F2_ZERO_C;
+    for (int i = 0; i < 6; i++) {
+        if (f2_is_zero(a.c[i])) continue;
+        for (int j = 0; j < 6; j++) {
+            if (f2_is_zero(b.c[j])) continue;
+            fp2 m;
+            f2_mul(m, a.c[i], b.c[j]);
+            f2_add(acc[i + j], acc[i + j], m);
+        }
+    }
+    for (int k = 0; k < 6; k++) {
+        if (k + 6 <= 10) {
+            fp2 hx;
+            f2_mul_xi(hx, acc[k + 6]);
+            f2_add(acc[k], acc[k], hx);
+        }
+        o.c[k] = acc[k];
+    }
+}
+
+static void f12_sqr(fp12 &o, const fp12 &a) { f12_mul(o, a, a); }
+
+// sparse multiply by a line l = l0 + l2 w^2 + l3 w^3  (18 f2 muls)
+static void f12_mul_line(fp12 &o, const fp12 &a, const fp2 &l0,
+                         const fp2 &l2, const fp2 &l3) {
+    fp2 acc[11];
+    for (int k = 0; k < 11; k++) acc[k] = F2_ZERO_C;
+    for (int i = 0; i < 6; i++) {
+        if (f2_is_zero(a.c[i])) continue;
+        fp2 m;
+        if (!f2_is_zero(l0)) {
+            f2_mul(m, a.c[i], l0);
+            f2_add(acc[i], acc[i], m);
+        }
+        if (!f2_is_zero(l2)) {
+            f2_mul(m, a.c[i], l2);
+            f2_add(acc[i + 2], acc[i + 2], m);
+        }
+        if (!f2_is_zero(l3)) {
+            f2_mul(m, a.c[i], l3);
+            f2_add(acc[i + 3], acc[i + 3], m);
+        }
+    }
+    for (int k = 0; k < 6; k++) {
+        if (k + 6 <= 10) {
+            fp2 hx;
+            f2_mul_xi(hx, acc[k + 6]);
+            f2_add(acc[k], acc[k], hx);
+        }
+        o.c[k] = acc[k];
+    }
+}
+
+// w -> -w (= frobenius^6)
+static void f12_conj(fp12 &o, const fp12 &a) {
+    o.c[0] = a.c[0];
+    f2_neg(o.c[1], a.c[1]);
+    o.c[2] = a.c[2];
+    f2_neg(o.c[3], a.c[3]);
+    o.c[4] = a.c[4];
+    f2_neg(o.c[5], a.c[5]);
+}
+
+// GAMMA[i] = XI^(i*(p-1)/6) in normal form (derived by the python impl;
+// converted to Montgomery at first use)
+static const uint64_t GAMMA_RAW[6][2][6] = {
+    {{0x0000000000000001ull, 0, 0, 0, 0, 0}, {0, 0, 0, 0, 0, 0}},
+    {{0x8d0775ed92235fb8ull, 0xf67ea53d63e7813dull, 0x7b2443d784bab9c4ull, 0x0fd603fd3cbd5f4full, 0xc231beb4202c0d1full, 0x1904d3bf02bb0667ull},
+     {0x2cf78a126ddc4af3ull, 0x282d5ac14d6c7ec2ull, 0xec0c8ec971f63c5full, 0x54a14787b6c7b36full, 0x88e9e902231f9fb8ull, 0x00fc3e2b36c4e032ull}},
+    {{0, 0, 0, 0, 0, 0},
+     {0x8bfd00000000aaacull, 0x409427eb4f49fffdull, 0x897d29650fb85f9bull, 0xaa0d857d89759ad4ull, 0xec02408663d4de85ull, 0x1a0111ea397fe699ull}},
+    {{0xc81084fbede3cc09ull, 0xee67992f72ec05f4ull, 0x77f76e17009241c5ull, 0x48395dabc2d3435eull, 0x6831e36d6bd17ffeull, 0x06af0e0437ff400bull},
+     {0xc81084fbede3cc09ull, 0xee67992f72ec05f4ull, 0x77f76e17009241c5ull, 0x48395dabc2d3435eull, 0x6831e36d6bd17ffeull, 0x06af0e0437ff400bull}},
+    {{0x8bfd00000000aaadull, 0x409427eb4f49fffdull, 0x897d29650fb85f9bull, 0xaa0d857d89759ad4ull, 0xec02408663d4de85ull, 0x1a0111ea397fe699ull},
+     {0, 0, 0, 0, 0, 0}},
+    {{0x9b18fae980078116ull, 0xc63a3e6e257f8732ull, 0x8beadf4d8e9c0566ull, 0xf39816240c0b8feeull, 0xdf47fa6b48b1e045ull, 0x05b2cfd9013a5fd8ull},
+     {0x1ee605167ff82995ull, 0x5871c1908bd478cdull, 0xdb45f3536814f0bdull, 0x70df3560e77982d0ull, 0x6bd3ad4afa99cc91ull, 0x144e4211384586c1ull}},
+};
+
+static fp2 GAMMA[6];
+static bool gamma_ready = false;
+
+static void init_gamma() {
+    if (gamma_ready) return;
+    for (int i = 0; i < 6; i++) {
+        fp c0, c1;
+        for (int j = 0; j < 6; j++) {
+            c0.l[j] = GAMMA_RAW[i][0][j];
+            c1.l[j] = GAMMA_RAW[i][1][j];
+        }
+        fp_to_mont(GAMMA[i].c0, c0);
+        fp_to_mont(GAMMA[i].c1, c1);
+    }
+    gamma_ready = true;
+}
+
+// a^p: conjugate each Fp2 coefficient, twist by GAMMA[i]
+static void f12_frob(fp12 &o, const fp12 &a) {
+    for (int i = 0; i < 6; i++) {
+        fp2 cj;
+        f2_conj(cj, a.c[i]);
+        f2_mul(o.c[i], cj, GAMMA[i]);
+    }
+}
+
+static void f12_frob_n(fp12 &o, const fp12 &a, int n) {
+    o = a;
+    for (int k = 0; k < n; k++) {
+        fp12 t;
+        f12_frob(t, o);
+        o = t;
+    }
+}
+
+// Fp6 = Fp2[v]/(v^3 - XI) used only for inversion via the even subalgebra
+static void f6_mul(fp2 o[3], const fp2 a[3], const fp2 b[3]) {
+    fp2 t0, t1, t2, s, u, w;
+    f2_mul(t0, a[0], b[0]);
+    f2_mul(t1, a[1], b[1]);
+    f2_mul(t2, a[2], b[2]);
+    // c0 = t0 + XI*((a1+a2)(b1+b2) - t1 - t2)
+    f2_add(s, a[1], a[2]);
+    f2_add(u, b[1], b[2]);
+    f2_mul(w, s, u);
+    f2_sub(w, w, t1);
+    f2_sub(w, w, t2);
+    f2_mul_xi(w, w);
+    f2_add(o[0], t0, w);
+    // c1 = (a0+a1)(b0+b1) - t0 - t1 + XI*t2
+    f2_add(s, a[0], a[1]);
+    f2_add(u, b[0], b[1]);
+    f2_mul(w, s, u);
+    f2_sub(w, w, t0);
+    f2_sub(w, w, t1);
+    fp2 x2;
+    f2_mul_xi(x2, t2);
+    f2_add(o[1], w, x2);
+    // c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+    f2_add(s, a[0], a[2]);
+    f2_add(u, b[0], b[2]);
+    f2_mul(w, s, u);
+    f2_sub(w, w, t0);
+    f2_sub(w, w, t2);
+    f2_add(o[2], w, t1);
+}
+
+static void f6_inv(fp2 o[3], const fp2 a[3]) {
+    fp2 c0, c1, c2, t, s, ti;
+    f2_sqr(c0, a[0]);
+    f2_mul(t, a[1], a[2]);
+    f2_mul_xi(t, t);
+    f2_sub(c0, c0, t);
+    f2_sqr(c1, a[2]);
+    f2_mul_xi(c1, c1);
+    f2_mul(t, a[0], a[1]);
+    f2_sub(c1, c1, t);
+    f2_sqr(c2, a[1]);
+    f2_mul(t, a[0], a[2]);
+    f2_sub(c2, c2, t);
+    // t = a0*c0 + XI*(a1*c2 + a2*c1)
+    f2_mul(t, a[1], c2);
+    f2_mul(s, a[2], c1);
+    f2_add(t, t, s);
+    f2_mul_xi(t, t);
+    f2_mul(s, a[0], c0);
+    f2_add(t, t, s);
+    f2_inv(ti, t);
+    f2_mul(o[0], c0, ti);
+    f2_mul(o[1], c1, ti);
+    f2_mul(o[2], c2, ti);
+}
+
+static void f12_inv(fp12 &o, const fp12 &a) {
+    fp12 ac, n;
+    f12_conj(ac, a);
+    f12_mul(n, a, ac); // even coefficients only
+    fp2 n6[3] = {n.c[0], n.c[2], n.c[4]};
+    fp2 n6i[3];
+    f6_inv(n6i, n6);
+    fp12 n12;
+    for (int i = 0; i < 6; i++) n12.c[i] = F2_ZERO_C;
+    n12.c[0] = n6i[0];
+    n12.c[2] = n6i[1];
+    n12.c[4] = n6i[2];
+    f12_mul(o, ac, n12);
+}
+
+// a^|x| by square-and-multiply over X_ABS's bits
+static void f12_exp_xabs(fp12 &o, const fp12 &a) {
+    fp12 r;
+    f12_one(r);
+    int top = 63;
+    while (!((X_ABS >> top) & 1)) top--;
+    for (int i = top; i >= 0; i--) {
+        fp12 t;
+        f12_sqr(t, r);
+        r = t;
+        if ((X_ABS >> i) & 1) {
+            f12_mul(t, r, a);
+            r = t;
+        }
+    }
+    o = r;
+}
+
+// a^x for the negative BLS parameter (conj == inverse for unitary elts)
+static void f12_exp_x_signed(fp12 &o, const fp12 &a) {
+    fp12 t;
+    f12_exp_xabs(t, a);
+    f12_conj(o, t);
+}
+
+static void final_exponentiation(fp12 &o, const fp12 &f_in) {
+    fp12 f, t, u;
+    // easy part: f^((p^6-1)(p^2+1))
+    f12_conj(t, f_in);
+    f12_inv(u, f_in);
+    f12_mul(f, t, u); // f^(p^6-1)
+    f12_frob_n(t, f, 2);
+    f12_mul(u, t, f); // ^(p^2+1)
+    f = u;
+    // hard part: f^((x-1)^2 (x+p) (x^2+p^2-1)) * f^3
+    fp12 a, b, c;
+    f12_exp_x_signed(a, f);
+    f12_conj(t, f);
+    f12_mul(a, a, t); // f^(x-1)
+    f12_exp_x_signed(t, a);
+    f12_conj(u, a);
+    f12_mul(a, t, u); // f^((x-1)^2)
+    f12_exp_x_signed(b, a);
+    f12_frob(t, a);
+    f12_mul(b, b, t); // ^(x+p)
+    f12_exp_x_signed(t, b);
+    f12_exp_x_signed(c, t); // ^(x^2)
+    f12_frob_n(t, b, 2);
+    f12_mul(c, c, t);
+    f12_conj(t, b);
+    f12_mul(c, c, t); // ^(x^2+p^2-1)
+    f12_sqr(t, f);
+    f12_mul(t, t, f); // f^3
+    f12_mul(o, c, t);
+}
+
+// --- G1 (Jacobian over Fp), G2 (Jacobian over Fp2) -----------------------
+
+struct g1 { fp x, y, z; };
+struct g2 { fp2 x, y; fp2 z; };
+
+static inline bool g1_is_inf(const g1 &p) { return fp_is_zero(p.z); }
+static inline bool g2_is_inf(const g2 &p) { return f2_is_zero(p.z); }
+
+static void g1_double(g1 &o, const g1 &p) {
+    if (g1_is_inf(p)) { o = p; return; }
+    fp a, b, c, d, x3, y3, z3, t;
+    fp_sqr(a, p.x);
+    fp_sqr(b, p.y);
+    fp_sqr(c, b);
+    // d = 2*((x+b)^2 - a - c)
+    fp_add(t, p.x, b);
+    fp_sqr(t, t);
+    fp_sub(t, t, a);
+    fp_sub(t, t, c);
+    fp_dbl(d, t);
+    fp e;
+    fp_dbl(e, a);
+    fp_add(e, e, a); // 3a
+    fp_sqr(x3, e);
+    fp_sub(x3, x3, d);
+    fp_sub(x3, x3, d);
+    fp_sub(t, d, x3);
+    fp_mul(y3, e, t);
+    fp c8;
+    fp_dbl(c8, c);
+    fp_dbl(c8, c8);
+    fp_dbl(c8, c8);
+    fp_sub(y3, y3, c8);
+    fp_mul(z3, p.y, p.z);
+    fp_dbl(z3, z3);
+    o.x = x3; o.y = y3; o.z = z3;
+}
+
+static void g1_add(g1 &o, const g1 &p, const g1 &q) {
+    if (g1_is_inf(p)) { o = q; return; }
+    if (g1_is_inf(q)) { o = p; return; }
+    fp z1z1, z2z2, u1, u2, s1, s2, t;
+    fp_sqr(z1z1, p.z);
+    fp_sqr(z2z2, q.z);
+    fp_mul(u1, p.x, z2z2);
+    fp_mul(u2, q.x, z1z1);
+    fp_mul(s1, p.y, q.z);
+    fp_mul(s1, s1, z2z2);
+    fp_mul(s2, q.y, p.z);
+    fp_mul(s2, s2, z1z1);
+    if (fp_eq(u1, u2)) {
+        if (fp_eq(s1, s2)) { g1_double(o, p); return; }
+        o.x = FP_ONE_MONT; o.y = FP_ONE_MONT; o.z = FP_ZERO; // infinity
+        return;
+    }
+    fp h, i, j, r, v;
+    fp_sub(h, u2, u1);
+    fp_dbl(t, h);
+    fp_sqr(i, t);
+    fp_mul(j, h, i);
+    fp_sub(r, s2, s1);
+    fp_dbl(r, r);
+    fp_mul(v, u1, i);
+    fp x3, y3, z3;
+    fp_sqr(x3, r);
+    fp_sub(x3, x3, j);
+    fp_sub(x3, x3, v);
+    fp_sub(x3, x3, v);
+    fp_sub(t, v, x3);
+    fp_mul(y3, r, t);
+    fp_mul(t, s1, j);
+    fp_dbl(t, t);
+    fp_sub(y3, y3, t);
+    fp_add(z3, p.z, q.z);
+    fp_sqr(z3, z3);
+    fp_sub(z3, z3, z1z1);
+    fp_sub(z3, z3, z2z2);
+    fp_mul(z3, z3, h);
+    o.x = x3; o.y = y3; o.z = z3;
+}
+
+static void g1_neg(g1 &o, const g1 &p) {
+    o.x = p.x;
+    fp_neg(o.y, p.y);
+    o.z = p.z;
+}
+
+// scalar: nbits from limbs (little-endian uint64 array)
+static void g1_mul_limbs(g1 &o, const g1 &p, const uint64_t *k, int nlimbs) {
+    g1 r = {FP_ONE_MONT, FP_ONE_MONT, FP_ZERO};
+    int top = nlimbs * 64 - 1;
+    while (top >= 0 && !((k[top / 64] >> (top % 64)) & 1)) top--;
+    for (int i = top; i >= 0; i--) {
+        g1 t;
+        g1_double(t, r);
+        r = t;
+        if ((k[i / 64] >> (i % 64)) & 1) {
+            g1_add(t, r, p);
+            r = t;
+        }
+    }
+    o = r;
+}
+
+static void g2_double(g2 &o, const g2 &p) {
+    if (g2_is_inf(p)) { o = p; return; }
+    fp2 a, b, c, d, e, x3, y3, z3, t;
+    f2_sqr(a, p.x);
+    f2_sqr(b, p.y);
+    f2_sqr(c, b);
+    f2_add(t, p.x, b);
+    f2_sqr(t, t);
+    f2_sub(t, t, a);
+    f2_sub(t, t, c);
+    f2_add(d, t, t);
+    f2_add(e, a, a);
+    f2_add(e, e, a);
+    f2_sqr(x3, e);
+    f2_sub(x3, x3, d);
+    f2_sub(x3, x3, d);
+    f2_sub(t, d, x3);
+    f2_mul(y3, e, t);
+    fp2 c8;
+    f2_add(c8, c, c);
+    f2_add(c8, c8, c8);
+    f2_add(c8, c8, c8);
+    f2_sub(y3, y3, c8);
+    f2_mul(z3, p.y, p.z);
+    f2_add(z3, z3, z3);
+    o.x = x3; o.y = y3; o.z = z3;
+}
+
+static void g2_add(g2 &o, const g2 &p, const g2 &q) {
+    if (g2_is_inf(p)) { o = q; return; }
+    if (g2_is_inf(q)) { o = p; return; }
+    fp2 z1z1, z2z2, u1, u2, s1, s2, t;
+    f2_sqr(z1z1, p.z);
+    f2_sqr(z2z2, q.z);
+    f2_mul(u1, p.x, z2z2);
+    f2_mul(u2, q.x, z1z1);
+    f2_mul(s1, p.y, q.z);
+    f2_mul(s1, s1, z2z2);
+    f2_mul(s2, q.y, p.z);
+    f2_mul(s2, s2, z1z1);
+    if (f2_eq(u1, u2)) {
+        if (f2_eq(s1, s2)) { g2_double(o, p); return; }
+        o.x.c0 = FP_ONE_MONT; o.x.c1 = FP_ZERO;
+        o.y = o.x;
+        o.z = F2_ZERO_C;
+        return;
+    }
+    fp2 h, i, j, r, v;
+    f2_sub(h, u2, u1);
+    f2_add(t, h, h);
+    f2_sqr(i, t);
+    f2_mul(j, h, i);
+    f2_sub(r, s2, s1);
+    f2_add(r, r, r);
+    f2_mul(v, u1, i);
+    fp2 x3, y3, z3;
+    f2_sqr(x3, r);
+    f2_sub(x3, x3, j);
+    f2_sub(x3, x3, v);
+    f2_sub(x3, x3, v);
+    f2_sub(t, v, x3);
+    f2_mul(y3, r, t);
+    f2_mul(t, s1, j);
+    f2_add(t, t, t);
+    f2_sub(y3, y3, t);
+    f2_add(z3, p.z, q.z);
+    f2_sqr(z3, z3);
+    f2_sub(z3, z3, z1z1);
+    f2_sub(z3, z3, z2z2);
+    f2_mul(z3, z3, h);
+    o.x = x3; o.y = y3; o.z = z3;
+}
+
+static void g2_mul_limbs(g2 &o, const g2 &p, const uint64_t *k, int nlimbs) {
+    g2 r;
+    r.x.c0 = FP_ONE_MONT; r.x.c1 = FP_ZERO;
+    r.y = r.x;
+    r.z = F2_ZERO_C;
+    int top = nlimbs * 64 - 1;
+    while (top >= 0 && !((k[top / 64] >> (top % 64)) & 1)) top--;
+    for (int i = top; i >= 0; i--) {
+        g2 t;
+        g2_double(t, r);
+        r = t;
+        if ((k[i / 64] >> (i % 64)) & 1) {
+            g2_add(t, r, p);
+            r = t;
+        }
+    }
+    o = r;
+}
+
+// to affine; p must not be infinity
+static void g1_to_affine(fp &ax, fp &ay, const g1 &p) {
+    fp zi, zi2, zi3;
+    fp_inv(zi, p.z);
+    fp_sqr(zi2, zi);
+    fp_mul(zi3, zi2, zi);
+    fp_mul(ax, p.x, zi2);
+    fp_mul(ay, p.y, zi3);
+}
+
+static void g2_to_affine(fp2 &ax, fp2 &ay, const g2 &p) {
+    fp2 zi, zi2, zi3;
+    f2_inv(zi, p.z);
+    f2_sqr(zi2, zi);
+    f2_mul(zi3, zi2, zi);
+    f2_mul(ax, p.x, zi2);
+    f2_mul(ay, p.y, zi3);
+}
+
+// on-curve checks (affine): y^2 = x^3 + 4  /  y^2 = x^3 + 4(1+u)
+static bool g1_on_curve_affine(const fp &x, const fp &y) {
+    fp l, r, t;
+    fp_sqr(l, y);
+    fp_sqr(t, x);
+    fp_mul(r, t, x);
+    fp four_n = {{4, 0, 0, 0, 0, 0}};
+    fp four;
+    fp_to_mont(four, four_n);
+    fp_add(r, r, four);
+    return fp_eq(l, r);
+}
+
+static bool g2_on_curve_affine(const fp2 &x, const fp2 &y) {
+    fp2 l, r, t, b2;
+    f2_sqr(l, y);
+    f2_sqr(t, x);
+    f2_mul(r, t, x);
+    fp four_n = {{4, 0, 0, 0, 0, 0}};
+    fp four;
+    fp_to_mont(four, four_n);
+    b2.c0 = four;
+    b2.c1 = four;
+    f2_add(r, r, b2);
+    return f2_eq(l, r);
+}
+
+// --- wire parsing ---------------------------------------------------------
+
+// G1: x||y, 96 bytes BE; all-zero = infinity. Returns 1 ok (+pt), 0 inf,
+// -1 malformed.
+static int g1_from_wire(g1 &o, const uint8_t *b) {
+    bool zero = true;
+    for (int i = 0; i < 96; i++)
+        if (b[i]) { zero = false; break; }
+    if (zero) {
+        o.x = FP_ONE_MONT; o.y = FP_ONE_MONT; o.z = FP_ZERO;
+        return 0;
+    }
+    if (fp_from_bytes(o.x, b) < 0) return -1;
+    if (fp_from_bytes(o.y, b + 48) < 0) return -1;
+    o.z = FP_ONE_MONT;
+    if (!g1_on_curve_affine(o.x, o.y)) return -1;
+    return 1;
+}
+
+static void g1_to_wire(uint8_t *b, const g1 &p) {
+    if (g1_is_inf(p)) {
+        memset(b, 0, 96);
+        return;
+    }
+    fp ax, ay;
+    g1_to_affine(ax, ay, p);
+    fp_to_bytes(b, ax);
+    fp_to_bytes(b + 48, ay);
+}
+
+// G2 wire: x.c1||x.c0||y.c1||y.c0 (matches crypto/bls_signatures.py)
+static int g2_from_wire(g2 &o, const uint8_t *b) {
+    bool zero = true;
+    for (int i = 0; i < 192; i++)
+        if (b[i]) { zero = false; break; }
+    if (zero) {
+        o.x.c0 = FP_ONE_MONT; o.x.c1 = FP_ZERO;
+        o.y = o.x;
+        o.z = F2_ZERO_C;
+        return 0;
+    }
+    if (fp_from_bytes(o.x.c1, b) < 0) return -1;
+    if (fp_from_bytes(o.x.c0, b + 48) < 0) return -1;
+    if (fp_from_bytes(o.y.c1, b + 96) < 0) return -1;
+    if (fp_from_bytes(o.y.c0, b + 144) < 0) return -1;
+    o.z.c0 = FP_ONE_MONT;
+    o.z.c1 = FP_ZERO;
+    if (!g2_on_curve_affine(o.x, o.y)) return -1;
+    return 1;
+}
+
+static void g2_to_wire(uint8_t *b, const g2 &p) {
+    if (g2_is_inf(p)) {
+        memset(b, 0, 192);
+        return;
+    }
+    fp2 ax, ay;
+    g2_to_affine(ax, ay, p);
+    fp_to_bytes(b, ax.c1);
+    fp_to_bytes(b + 48, ax.c0);
+    fp_to_bytes(b + 96, ay.c1);
+    fp_to_bytes(b + 144, ay.c0);
+}
+
+static void scalar_from_be(uint64_t k[4], const uint8_t *b) {
+    for (int i = 0; i < 4; i++) {
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++) v = (v << 8) | b[(3 - i) * 8 + j];
+        k[i] = v;
+    }
+}
+
+// subgroup: r*P == inf
+static bool g1_in_subgroup(const g1 &p) {
+    if (g1_is_inf(p)) return true;
+    g1 t;
+    g1_mul_limbs(t, p, FR_R, 4);
+    return g1_is_inf(t);
+}
+
+static bool g2_in_subgroup(const g2 &p) {
+    if (g2_is_inf(p)) return true;
+    g2 t;
+    g2_mul_limbs(t, p, FR_R, 4);
+    return g2_is_inf(t);
+}
+
+// --- Miller loop + pairing ----------------------------------------------
+
+// line through the twist point (xt,yt) with slope lam, evaluated at
+// affine P=(xp,yp):  l = (lam*xt - yt) - (lam*xp) w^2 + yp w^3
+static void line_eval(fp2 &l0, fp2 &l2, fp2 &l3, const fp2 &lam,
+                      const fp2 &xt, const fp2 &yt, const fp &xp,
+                      const fp &yp) {
+    fp2 t;
+    f2_mul(t, lam, xt);
+    f2_sub(l0, t, yt);
+    f2_scale(t, lam, xp);
+    f2_neg(l2, t);
+    l3.c0 = yp;
+    l3.c1 = FP_ZERO;
+}
+
+// prod_i f_{|x|,Q_i}(P_i), conjugated for x<0; inputs affine, n <= 64
+static void miller_loop(fp12 &f, const fp g1x[], const fp g1y[],
+                        fp2 g2x[], fp2 g2y[], int n) {
+    f12_one(f);
+    if (n == 0) return;
+    // T_i start at Q_i (affine Fp2 coords)
+    fp2 tx[64], ty[64];
+    for (int i = 0; i < n; i++) {
+        tx[i] = g2x[i];
+        ty[i] = g2y[i];
+    }
+    int top = 63;
+    while (!((X_ABS >> top) & 1)) top--;
+    for (int bi = top - 1; bi >= 0; bi--) {
+        fp12 t;
+        f12_sqr(t, f);
+        f = t;
+        for (int i = 0; i < n; i++) {
+            // doubling: lam = 3 xt^2 / (2 yt)
+            fp2 num, den, lam, l0, l2, l3;
+            f2_sqr(num, tx[i]);
+            fp2 n3;
+            f2_add(n3, num, num);
+            f2_add(num, n3, num);
+            f2_add(den, ty[i], ty[i]);
+            f2_inv(den, den);
+            f2_mul(lam, num, den);
+            line_eval(l0, l2, l3, lam, tx[i], ty[i], g1x[i], g1y[i]);
+            f12_mul_line(t, f, l0, l2, l3);
+            f = t;
+            fp2 x3, y3, s;
+            f2_sqr(x3, lam);
+            f2_add(s, tx[i], tx[i]);
+            f2_sub(x3, x3, s);
+            f2_sub(s, tx[i], x3);
+            f2_mul(y3, lam, s);
+            f2_sub(y3, y3, ty[i]);
+            tx[i] = x3;
+            ty[i] = y3;
+        }
+        if ((X_ABS >> bi) & 1) {
+            for (int i = 0; i < n; i++) {
+                // addition T + Q: lam = (yt - yq)/(xt - xq)
+                fp2 num, den, lam, l0, l2, l3;
+                f2_sub(num, ty[i], g2y[i]);
+                f2_sub(den, tx[i], g2x[i]);
+                f2_inv(den, den);
+                f2_mul(lam, num, den);
+                line_eval(l0, l2, l3, lam, tx[i], ty[i], g1x[i], g1y[i]);
+                fp12 t;
+                f12_mul_line(t, f, l0, l2, l3);
+                f = t;
+                fp2 x3, y3, s;
+                f2_sqr(x3, lam);
+                f2_sub(x3, x3, tx[i]);
+                f2_sub(x3, x3, g2x[i]);
+                f2_sub(s, tx[i], x3);
+                f2_mul(y3, lam, s);
+                f2_sub(y3, y3, ty[i]);
+                tx[i] = x3;
+                ty[i] = y3;
+            }
+        }
+    }
+    fp12 t;
+    f12_conj(t, f);
+    f = t;
+}
+
+// --- exported C ABI -------------------------------------------------------
+
+extern "C" {
+
+// prod e(P_i, Q_i) == 1?  g1s: n*96 bytes, g2s: n*192 bytes.
+// 1 yes / 0 no / -1 malformed input. Points are NOT subgroup-checked here
+// (callers check on deserialize via tmbls_g1_check / tmbls_g2_check).
+int tmbls_pairing_check(const uint8_t *g1s, const uint8_t *g2s, size_t n) {
+    init_gamma();
+    fp g1x[64], g1y[64];
+    fp2 g2x[64], g2y[64];
+    fp12 acc;
+    f12_one(acc);
+    int m = 0;
+    for (size_t i = 0; i < n; i++) {
+        g1 p;
+        g2 q;
+        int rp = g1_from_wire(p, g1s + 96 * i);
+        int rq = g2_from_wire(q, g2s + 192 * i);
+        if (rp < 0 || rq < 0) return -1;
+        if (rp == 0 || rq == 0) continue; // infinity factor is 1
+        g1x[m] = p.x;
+        g1y[m] = p.y;
+        g2x[m] = q.x;
+        g2y[m] = q.y;
+        m++;
+        if (m == 64) { // flush a full chunk through the Miller loop
+            fp12 f;
+            miller_loop(f, g1x, g1y, g2x, g2y, m);
+            fp12 t;
+            f12_mul(t, acc, f);
+            acc = t;
+            m = 0;
+        }
+    }
+    if (m > 0) {
+        fp12 f;
+        miller_loop(f, g1x, g1y, g2x, g2y, m);
+        fp12 t;
+        f12_mul(t, acc, f);
+        acc = t;
+    }
+    fp12 out;
+    final_exponentiation(out, acc);
+    return f12_is_one(out) ? 1 : 0;
+}
+
+int tmbls_g1_mul(uint8_t *out, const uint8_t *in, const uint8_t *k_be) {
+    g1 p, r;
+    int rc = g1_from_wire(p, in);
+    if (rc < 0) return -1;
+    uint64_t k[4];
+    scalar_from_be(k, k_be);
+    g1_mul_limbs(r, p, k, 4);
+    g1_to_wire(out, r);
+    return 1;
+}
+
+int tmbls_g2_mul(uint8_t *out, const uint8_t *in, const uint8_t *k_be) {
+    g2 p, r;
+    int rc = g2_from_wire(p, in);
+    if (rc < 0) return -1;
+    uint64_t k[4];
+    scalar_from_be(k, k_be);
+    g2_mul_limbs(r, p, k, 4);
+    g2_to_wire(out, r);
+    return 1;
+}
+
+// out = sum_i k_i * P_i  (k may be NULL for a plain sum)
+int tmbls_g1_msm(uint8_t *out, const uint8_t *pts, const uint8_t *ks,
+                 size_t n) {
+    g1 acc = {FP_ONE_MONT, FP_ONE_MONT, FP_ZERO};
+    for (size_t i = 0; i < n; i++) {
+        g1 p;
+        int rc = g1_from_wire(p, pts + 96 * i);
+        if (rc < 0) return -1;
+        if (rc == 0) continue;
+        if (ks != nullptr) {
+            uint64_t k[4];
+            scalar_from_be(k, ks + 32 * i);
+            g1 t;
+            g1_mul_limbs(t, p, k, 4);
+            p = t;
+        }
+        g1 t;
+        g1_add(t, acc, p);
+        acc = t;
+    }
+    g1_to_wire(out, acc);
+    return 1;
+}
+
+int tmbls_g2_msm(uint8_t *out, const uint8_t *pts, const uint8_t *ks,
+                 size_t n) {
+    g2 acc;
+    acc.x.c0 = FP_ONE_MONT; acc.x.c1 = FP_ZERO;
+    acc.y = acc.x;
+    acc.z = F2_ZERO_C;
+    for (size_t i = 0; i < n; i++) {
+        g2 p;
+        int rc = g2_from_wire(p, pts + 192 * i);
+        if (rc < 0) return -1;
+        if (rc == 0) continue;
+        if (ks != nullptr) {
+            uint64_t k[4];
+            scalar_from_be(k, ks + 32 * i);
+            g2 t;
+            g2_mul_limbs(t, p, k, 4);
+            p = t;
+        }
+        g2 t;
+        g2_add(t, acc, p);
+        acc = t;
+    }
+    g2_to_wire(out, acc);
+    return 1;
+}
+
+// on-curve + subgroup: 1 ok / 0 not in subgroup / -1 malformed
+int tmbls_g1_check(const uint8_t *in) {
+    g1 p;
+    int rc = g1_from_wire(p, in);
+    if (rc < 0) return -1;
+    if (rc == 0) return 1;
+    return g1_in_subgroup(p) ? 1 : 0;
+}
+
+int tmbls_g2_check(const uint8_t *in) {
+    g2 p;
+    int rc = g2_from_wire(p, in);
+    if (rc < 0) return -1;
+    if (rc == 0) return 1;
+    return g2_in_subgroup(p) ? 1 : 0;
+}
+
+} // extern "C"

@@ -250,6 +250,10 @@ class Config:
         return self.path(self.base.priv_validator_state_file)
 
     @property
+    def bls_key_file(self) -> str:
+        return self.path(self.base.bls_key_file)
+
+    @property
     def wal_file(self) -> str:
         return self.path(self.consensus.wal_file)
 

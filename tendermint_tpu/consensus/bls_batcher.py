@@ -1,0 +1,63 @@
+"""Self-clocking micro-batcher for batch-point BLS signature checks.
+
+The reference verifies each batch-point precommit's BLS signature serially
+inside addVote (consensus/state.go:2362-2379) — fine in native Go, but a
+pairing per vote. Built on consensus/microbatch.py: checks that accumulate
+while the previous verification is in flight form the next batch, grouped
+by message (a consensus round produces a burst of signatures over ONE
+batch hash), and each group verifies as a single random-linear-combination
+aggregate — 2 pairings per burst instead of 2 per vote (via the L2 node's
+verify_signatures port, crypto/bls_signatures.verify_batch_same_message).
+
+Verdicts are tri-state: True/False are definitive; None means the
+verifier itself failed (L2 connection error, shutdown) — the reactor then
+falls back to the state machine's serial check instead of punishing the
+peer for an infrastructure problem.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs.log import Logger
+from .microbatch import MicroBatcher
+
+
+class BLSBatcher(MicroBatcher):
+    def __init__(self, l2_node, max_batch: int = 4096,
+                 logger: Optional[Logger] = None):
+        super().__init__(max_batch=max_batch, logger=logger,
+                         error_verdict=None)
+        self.l2 = l2_node
+
+    async def submit(self, tm_pubkey: bytes, message_hash: bytes,
+                     sig: bytes) -> Optional[bool]:
+        """True/False = signature verdict; None = could not verify."""
+        return await self.submit_item(
+            (bytes(tm_pubkey), bytes(message_hash), bytes(sig))
+        )
+
+    def _verify_items(self, batch: list) -> list:
+        """Group by message hash, batch-verify each group."""
+        groups: dict[bytes, list[int]] = {}
+        for i, (_, msg, _) in enumerate(batch):
+            groups.setdefault(msg, []).append(i)
+        verdicts: list = [None] * len(batch)
+        for msg, idxs in groups.items():
+            pks = [batch[i][0] for i in idxs]
+            sigs = [batch[i][2] for i in idxs]
+            try:
+                batch_fn = getattr(self.l2, "verify_signatures", None)
+                if batch_fn is not None:
+                    ok = batch_fn(pks, msg, sigs)
+                else:
+                    ok = [
+                        self.l2.verify_signature(pk, msg, s)
+                        for pk, s in zip(pks, sigs)
+                    ]
+            except Exception as e:  # L2 unavailable: unknown, not invalid
+                self.logger.error("bls group verify failed", err=repr(e))
+                ok = [None] * len(idxs)
+            for i, v in zip(idxs, ok):
+                verdicts[i] = None if v is None else bool(v)
+        return verdicts

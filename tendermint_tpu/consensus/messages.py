@@ -164,6 +164,9 @@ class VoteMessage:
     # already verified this vote's signature on the device, so the state
     # machine can insert without re-verifying (SURVEY.md §7.3 hard part 3)
     pre_verified: bool = False
+    # in-process only: the batch-point BLS signature already passed the
+    # reactor's aggregate micro-batcher (consensus/bls_batcher.py)
+    bls_pre_verified: bool = False
 
     TAG = 6
 

@@ -133,6 +133,11 @@ class ConsensusReactor(Reactor):
             vote_batcher = VoteBatcher(verifier=cs.verifier)
         self.vote_batcher = vote_batcher
         self.logger = logger or nop_logger()
+        # aggregate micro-batcher for batch-point BLS signatures: a
+        # round's burst verifies as 2 pairings instead of 2 per vote
+        from .bls_batcher import BLSBatcher
+
+        self.bls_batcher = BLSBatcher(cs.l2, logger=self.logger)
         self._peer_states: dict[str, PeerRoundState] = {}
         self._peer_tasks: dict[str, list[asyncio.Task]] = {}
         # fast-path: push our own messages + round steps
@@ -224,6 +229,8 @@ class ConsensusReactor(Reactor):
     async def on_stop(self) -> None:
         if self.vote_batcher is not None:
             self.vote_batcher.stop()
+        if self.bls_batcher is not None:
+            self.bls_batcher.stop()
 
     # --- receive ----------------------------------------------------------
 
@@ -329,8 +336,45 @@ class ConsensusReactor(Reactor):
                             peer, "invalid vote signature"
                         )
                         return
+                # batch-point precommits: pre-verify the BLS dual-signature
+                # through the aggregate micro-batcher (the round's burst
+                # costs 2 pairings total, not 2 per vote); the state
+                # machine then skips its serial l2.verify_signature
+                bls_pre_verified = False
+                if (
+                    pre_verified
+                    and pub is not None
+                    and vote.bls_signature
+                    and self.bls_batcher is not None
+                ):
+                    batch_hash = cs.batch_hash_for_vote(vote)
+                    if batch_hash:
+                        ok = await self.bls_batcher.submit(
+                            pub.data, batch_hash, vote.bls_signature
+                        )
+                        if ok is False:
+                            # definitive rejection: the signature is bad
+                            self.logger.info(
+                                "dropping vote with invalid BLS signature",
+                                peer=peer.id,
+                            )
+                            await self.switch.stop_peer_for_error(
+                                peer, "invalid BLS signature on batch hash"
+                            )
+                            return
+                        # ok None = verifier unavailable: fall through with
+                        # bls_pre_verified=False; the state machine's serial
+                        # check decides (don't punish the peer for it)
+                        bls_pre_verified = ok is True
                 await cs.peer_msg_queue.put(
-                    (VoteMessage(vote, pre_verified=pre_verified), peer.id)
+                    (
+                        VoteMessage(
+                            vote,
+                            pre_verified=pre_verified,
+                            bls_pre_verified=bls_pre_verified,
+                        ),
+                        peer.id,
+                    )
                 )
         elif channel_id == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, VoteSetBitsMessage) and msg.height == cs.rs.height:

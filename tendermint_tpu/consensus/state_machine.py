@@ -321,7 +321,10 @@ class ConsensusState:
                 await self._handle_complete_proposal(msg.height)
         elif isinstance(msg, VoteMessage):
             await self._try_add_vote(
-                msg.vote, peer_id, pre_verified=msg.pre_verified
+                msg.vote,
+                peer_id,
+                pre_verified=msg.pre_verified,
+                bls_pre_verified=msg.bls_pre_verified,
             )
         else:
             self.logger.error("unknown msg type", msg=type(msg).__name__)
@@ -633,6 +636,25 @@ class ConsensusState:
                 )
                 if expect != bh:
                     raise ValueError("batch hash mismatch in proposal")
+                # decideBatchPointWithProposedBlock (reference :1365-1377):
+                # a non-proposer seals its OWN L2 batch at the proposed
+                # point and requires the locally-derived hash to equal the
+                # header's — otherwise the proposer and this node disagree
+                # about L2 batch contents and the proposal is invalid.
+                # (The proposer already sealed in _create_proposal_block
+                # and stored the batch data under its block hash.)
+                if self.batch_cache.batch_data(rs.proposal_block.hash()) is None:
+                    self.l2.calculate_batch_size_with_proposal_block(
+                        rs.proposal_block.encode(), True
+                    )
+                    local_hash, local_header = self.l2.seal_batch()
+                    if local_hash != bh:
+                        raise ValueError(
+                            "locally sealed batch hash disagrees with proposal"
+                        )
+                    self.batch_cache.store_batch_data(
+                        rs.proposal_block.hash(), local_hash, local_header
+                    )
         except ValueError as e:
             self.logger.info("prevoting nil: invalid proposal", err=repr(e))
             await self._sign_add_vote(VoteType.PREVOTE, b"", None)
@@ -798,13 +820,32 @@ class ConsensusState:
         # collect BLS contributions for batch points (morph)
         bls_datas = []
         if block.header.batch_hash:
-            for v in precommits.votes:
-                if v is not None and v.bls_signature:
+            candidates = [
+                v
+                for v in precommits.votes
+                if v is not None and v.bls_signature
+            ]
+            # Commit-time gate: a batch-point precommit that arrived BEFORE
+            # this node knew the proposal bypassed the ingestion-time BLS
+            # check (the batch hash was unknown); an unverified garbage
+            # signature must not reach commit_batch and poison the
+            # L1-bound aggregate. One batched check (2 pairings all-valid)
+            # keeps only contributions the L2 vouches for.
+            verdicts = self._verify_bls_datas(
+                block.header.batch_hash, candidates
+            )
+            for v, ok in zip(candidates, verdicts):
+                if ok:
                     bls_datas.append(
                         BlsData(
                             signer=v.validator_address,
                             signature=v.bls_signature,
                         )
+                    )
+                else:
+                    self.logger.error(
+                        "dropping invalid BLS contribution at commit",
+                        validator=v.validator_address.hex()[:12],
                     )
         state_copy = self.state.copy()
         new_state = await self.executor.apply_block(
@@ -896,10 +937,16 @@ class ConsensusState:
     # --- votes ------------------------------------------------------------
 
     async def _try_add_vote(
-        self, vote: Vote, peer_id: str, pre_verified: bool = False
+        self,
+        vote: Vote,
+        peer_id: str,
+        pre_verified: bool = False,
+        bls_pre_verified: bool = False,
     ) -> bool:
         try:
-            return await self._add_vote(vote, peer_id, pre_verified)
+            return await self._add_vote(
+                vote, peer_id, pre_verified, bls_pre_verified
+            )
         except ConflictingVoteError as e:
             # equivocation: report to the pool, which resolves the
             # validator against the HISTORICAL set at the vote's height and
@@ -920,7 +967,11 @@ class ConsensusState:
             return False
 
     async def _add_vote(
-        self, vote: Vote, peer_id: str, pre_verified: bool = False
+        self,
+        vote: Vote,
+        peer_id: str,
+        pre_verified: bool = False,
+        bls_pre_verified: bool = False,
     ) -> bool:
         """addVote (reference :2274-2519). `pre_verified` votes already
         passed the reactor's device micro-batcher; skip the serial check."""
@@ -959,7 +1010,7 @@ class ConsensusState:
             )
             if not vote.bls_signature:
                 raise ValueError("missing BLS signature at batch point")
-            if not self.l2.verify_signature(
+            if not bls_pre_verified and not self.l2.verify_signature(
                 val.pub_key.data, batch_hash, vote.bls_signature
             ):
                 raise ValueError("invalid BLS signature on batch hash")
@@ -994,6 +1045,37 @@ class ConsensusState:
             if blk is not None and blk.hash() == block_hash:
                 return blk.header.batch_hash
         return b""
+
+    def _verify_bls_datas(self, batch_hash: bytes, votes: list) -> list:
+        """Per-vote verdicts for the commit's BLS contributions via the
+        L2's batched port (falls back to serial verify_signature)."""
+        if not votes:
+            return []
+        pubkeys = []
+        for v in votes:
+            _, val = self.state.validators.get_by_address(
+                v.validator_address
+            )
+            pubkeys.append(val.pub_key.data if val is not None else b"")
+        sigs = [v.bls_signature for v in votes]
+        batch_fn = getattr(self.l2, "verify_signatures", None)
+        if batch_fn is not None:
+            return list(batch_fn(pubkeys, batch_hash, sigs))
+        return [
+            self.l2.verify_signature(pk, batch_hash, s)
+            for pk, s in zip(pubkeys, sigs)
+        ]
+
+    def batch_hash_for_vote(self, vote: Vote) -> bytes:
+        """The batch hash a current-height batch-point precommit's BLS
+        signature must cover, or b"" (reactor BLS micro-batcher hook)."""
+        if (
+            vote.type != VoteType.PRECOMMIT
+            or vote.is_nil()
+            or vote.height != self.rs.height
+        ):
+            return b""
+        return self._batch_hash_for_block(vote.block_id.hash)
 
     def pubkey_for_vote(self, vote: Vote):
         """Resolve the signer pubkey for a vote (reactor micro-batcher

@@ -1,16 +1,10 @@
 """Adaptive vote micro-batcher — the consensus-latency/TPU-batching bridge.
 
 SURVEY.md §7.3 hard part 3: consensus wants per-vote latency (votes arrive
-one at a time through gossip — §3.3), the device wants batches. This
-batcher is self-clocking: whatever votes accumulate while the previous
-device call is in flight form the next batch — under light load a vote is
-verified almost immediately (batch of 1 → host fast path inside
-BatchVerifier), under load batches grow to the device's appetite with no
-fixed timer adding latency.
-
-Ordering contract (SURVEY.md §2.3 "asynchronous but order-preserving"):
-results resolve strictly in submission order, so the deterministic state
-machine consumes verified votes in the order they arrived.
+one at a time through gossip — §3.3), the device wants batches. Built on
+the shared self-clocking machinery in consensus/microbatch.py; under light
+load a vote is verified almost immediately (batch of 1 → host fast path
+inside BatchVerifier), under load batches grow to the device's appetite.
 
 Reference counterpart: none — the reference verifies serially inside
 addVote under the consensus mutex (consensus/state.go:2274-2519,
@@ -21,76 +15,30 @@ micro-batcher before insertion" contract true.
 
 from __future__ import annotations
 
-import asyncio
-from collections import deque
 from typing import Optional
 
 from ..crypto.batch_verifier import BatchVerifier, SigItem, default_verifier
-from ..libs.log import Logger, nop_logger
+from ..libs.log import Logger
+from .microbatch import MicroBatcher
 
 
-class VoteBatcher:
+class VoteBatcher(MicroBatcher):
     def __init__(
         self,
         verifier: Optional[BatchVerifier] = None,
         max_batch: int = 8192,
         logger: Optional[Logger] = None,
     ):
+        # an ed25519 rejection only drops the one vote — False is safe
+        super().__init__(max_batch=max_batch, logger=logger,
+                         error_verdict=False)
         self.verifier = verifier or default_verifier()
-        self.max_batch = max_batch
-        self.logger = logger or nop_logger()
-        self._queue: list[tuple[SigItem, asyncio.Future]] = []
-        self._wakeup: Optional[asyncio.Event] = None
-        self._worker: Optional[asyncio.Task] = None
-        # telemetry: recent batch sizes (bounded; metrics hook + tests)
-        self.batch_sizes: deque[int] = deque(maxlen=1024)
-
-    def _ensure_worker(self) -> None:
-        if self._worker is None or self._worker.done():
-            self._wakeup = asyncio.Event()
-            self._worker = asyncio.create_task(self._run())
 
     async def submit(self, pubkey: bytes, msg: bytes, sig: bytes,
                      key_type: str = "ed25519") -> bool:
-        """Queue one signature; resolves to its verdict. Batches form from
-        everything queued while the device is busy."""
-        self._ensure_worker()
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.append((SigItem(pubkey, msg, sig, key_type), fut))
-        self._wakeup.set()
-        return await fut
+        """Queue one signature; resolves to its verdict."""
+        verdict = await self.submit_item(SigItem(pubkey, msg, sig, key_type))
+        return bool(verdict)
 
-    async def _run(self) -> None:
-        while True:
-            if not self._queue:
-                self._wakeup.clear()
-                await self._wakeup.wait()
-            batch, self._queue = (
-                self._queue[: self.max_batch],
-                self._queue[self.max_batch :],
-            )
-            items = [it for it, _ in batch]
-            self.batch_sizes.append(len(items))
-            try:
-                # the device call blocks; run it off-loop so more votes
-                # can queue meanwhile (they become the next batch)
-                ok = await asyncio.get_running_loop().run_in_executor(
-                    None, self.verifier.verify, items
-                )
-            except Exception as e:  # device failure -> reject, don't crash
-                self.logger.error("vote batch verify failed", err=repr(e))
-                ok = [False] * len(items)
-            for (_, fut), valid in zip(batch, ok):
-                if not fut.cancelled():
-                    fut.set_result(bool(valid))
-
-    def stop(self) -> None:
-        if self._worker is not None:
-            self._worker.cancel()
-            self._worker = None
-        # resolve anything still queued so awaiting submitters don't hang
-        # through shutdown (they see a rejection, which is safe)
-        pending, self._queue = self._queue, []
-        for _, fut in pending:
-            if not fut.done():
-                fut.set_result(False)
+    def _verify_items(self, items: list) -> list:
+        return [bool(v) for v in self.verifier.verify(items)]

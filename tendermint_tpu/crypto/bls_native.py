@@ -1,0 +1,144 @@
+"""ctypes loader for the native BLS12-381 host library.
+
+native/bls12_381.cpp re-implements crypto/bls12_381.py's exact
+construction (same flat-sextic tower, same wire format) in C++ with
+Montgomery 6x64 arithmetic — the framework's native equivalent of the
+reference's Go kilic dependency (blssignatures/bls_signatures.go imports;
+SURVEY.md §7.1 budgeted this host fast path). ~10x over the pure-Python
+pairing on this box.
+
+All entry points return None when the library is unavailable (no
+compiler); callers fall back to the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+_lock = threading.Lock()
+
+_FUNCS = (
+    "tmbls_pairing_check",
+    "tmbls_g1_mul",
+    "tmbls_g2_mul",
+    "tmbls_g1_msm",
+    "tmbls_g2_msm",
+    "tmbls_g1_check",
+    "tmbls_g2_check",
+)
+
+
+def native_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        repo_root = os.path.dirname(pkg_root)
+        so_path = os.path.join(pkg_root, "_tmbls.so")
+        src = os.path.join(repo_root, "native", "bls12_381.cpp")
+        if not os.path.exists(so_path) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(so_path)
+        ):
+            if not os.path.exists(src):
+                return None
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", so_path, src],
+                    check=True,
+                    capture_output=True,
+                    timeout=180,
+                )
+            except (subprocess.SubprocessError, OSError):
+                return None
+        try:
+            lib = ctypes.CDLL(so_path)
+            for name in _FUNCS:
+                getattr(lib, name).restype = ctypes.c_int
+            _lib = lib
+        except (OSError, AttributeError):
+            _lib = None
+        return _lib
+
+
+def pairing_check(g1s: bytes, g2s: bytes, n: int) -> Optional[bool]:
+    """prod e(P_i, Q_i) == 1 over wire-format point arrays; None = no lib,
+    raises ValueError on malformed points (callers validated already)."""
+    lib = native_lib()
+    if lib is None:
+        return None
+    rc = lib.tmbls_pairing_check(g1s, g2s, n)
+    if rc < 0:
+        raise ValueError("malformed point passed to native pairing")
+    return bool(rc)
+
+
+def g1_mul(point96: bytes, scalar32: bytes) -> Optional[bytes]:
+    lib = native_lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(96)
+    if lib.tmbls_g1_mul(out, point96, scalar32) < 0:
+        raise ValueError("malformed G1 point")
+    return out.raw
+
+
+def g2_mul(point192: bytes, scalar32: bytes) -> Optional[bytes]:
+    lib = native_lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(192)
+    if lib.tmbls_g2_mul(out, point192, scalar32) < 0:
+        raise ValueError("malformed G2 point")
+    return out.raw
+
+
+def g1_msm(points: bytes, scalars: Optional[bytes], n: int) -> Optional[bytes]:
+    """sum k_i * P_i (scalars None => plain sum). Wire-format in/out."""
+    lib = native_lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(96)
+    if lib.tmbls_g1_msm(out, points, scalars, n) < 0:
+        raise ValueError("malformed G1 point in MSM")
+    return out.raw
+
+
+def g2_msm(points: bytes, scalars: Optional[bytes], n: int) -> Optional[bytes]:
+    lib = native_lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(192)
+    if lib.tmbls_g2_msm(out, points, scalars, n) < 0:
+        raise ValueError("malformed G2 point in MSM")
+    return out.raw
+
+
+def g1_check(point96: bytes) -> Optional[bool]:
+    """on-curve + subgroup; None = no lib; False = bad subgroup;
+    raises on malformed encoding."""
+    lib = native_lib()
+    if lib is None:
+        return None
+    rc = lib.tmbls_g1_check(point96)
+    if rc < 0:
+        raise ValueError("malformed G1 encoding")
+    return bool(rc)
+
+
+def g2_check(point192: bytes) -> Optional[bool]:
+    lib = native_lib()
+    if lib is None:
+        return None
+    rc = lib.tmbls_g2_check(point192)
+    if rc < 0:
+        raise ValueError("malformed G2 encoding")
+    return bool(rc)

@@ -73,6 +73,19 @@ class L2Node(Protocol):
         consensus/state.go:2362-2379)."""
         ...
 
+    def verify_signatures(
+        self, tm_pubkeys: list[bytes], message_hash: bytes,
+        signatures: list[bytes],
+    ) -> list[bool]:
+        """Batched form of verify_signature over ONE message: per-index
+        verdicts. TPU-framework extension of the reference port (which
+        only verifies serially, l2node.go VerifySignature): the consensus
+        round produces a burst of signatures over the same batch hash, and
+        an implementation can verify the burst as a random-linear-
+        combination aggregate in 2 pairings (crypto/bls_signatures.
+        verify_batch_same_message) instead of 2 per vote."""
+        ...
+
     def append_bls_data(self, height: int, batch_hash: bytes, data: BlsData) -> None:
         """Hand an aggregatable BLS signature to the L2 node for L1
         submission (reference AppendBlsData)."""

@@ -22,11 +22,15 @@ class MockL2Node:
         txs_per_block: int = 2,
         batch_blocks_interval: int = 0,
         bls_verifier: Optional[Callable[[bytes, bytes, bytes], bool]] = None,
+        bls_batch_verifier: Optional[
+            Callable[[list, bytes, list], list]
+        ] = None,
     ):
         self._lock = threading.Lock()
         self.txs_per_block = txs_per_block
         self.batch_blocks_interval = batch_blocks_interval
         self._bls_verifier = bls_verifier
+        self._bls_batch_verifier = bls_batch_verifier
         # injected pending validator updates: height -> list[(type,pub,power)]
         self.validator_updates: dict[int, list] = {}
         # executed chain
@@ -85,6 +89,16 @@ class MockL2Node:
         # crypto/bls_signatures.BLSKeyRegistry for the real wiring.)
         return False
 
+    def verify_signatures(self, tm_pubkeys, message_hash, signatures):
+        if self._bls_batch_verifier is not None:
+            return self._bls_batch_verifier(
+                tm_pubkeys, message_hash, signatures
+            )
+        return [
+            self.verify_signature(pk, message_hash, sig)
+            for pk, sig in zip(tm_pubkeys, signatures)
+        ]
+
     def append_bls_data(self, height, batch_hash, data: BlsData) -> None:
         with self._lock:
             self.bls_appended.append((height, batch_hash, data))
@@ -103,19 +117,25 @@ class MockL2Node:
 
     def seal_batch(self) -> tuple[bytes, bytes]:
         with self._lock:
-            header = b"batch:" + pio.write_uvarint(
-                len(self.open_batch_blocks)
-            ) + b"".join(
-                hashlib.sha256(b).digest() for b in self.open_batch_blocks
-            )
-            h = hashlib.sha256(header).digest()
-            self.sealed = (h, header)
-            return h, header
+            return self._seal_locked()
+
+    def _seal_locked(self) -> tuple[bytes, bytes]:
+        header = b"batch:" + pio.write_uvarint(
+            len(self.open_batch_blocks)
+        ) + b"".join(
+            hashlib.sha256(b).digest() for b in self.open_batch_blocks
+        )
+        h = hashlib.sha256(header).digest()
+        self.sealed = (h, header)
+        return h, header
 
     def commit_batch(self, current_block_bytes, bls_datas) -> None:
         with self._lock:
             if self.sealed is None:
-                raise RuntimeError("commit_batch without seal_batch")
+                # replay paths (blocksync, WAL handshake) commit batch-point
+                # blocks without a preceding consensus-time seal; derive the
+                # batch from our own packed state, as the real L2 node does
+                self._seal_locked()
             self.committed_batches.append((self.sealed[0], list(bls_datas)))
             self.sealed = None
             self.open_batch_blocks = [current_block_bytes]

@@ -55,6 +55,9 @@ def init_files(config: Config, logger: Optional[Logger] = None) -> GenesisDoc:
     pv = FilePV.load_or_generate(
         config.priv_validator_key_file, config.priv_validator_state_file
     )
+    from ..crypto import bls_signatures as bls
+
+    bls.load_or_gen_bls_key(config.bls_key_file)
     gen_path = config.genesis_file
     if os.path.exists(gen_path):
         doc = GenesisDoc.from_file(gen_path)
@@ -101,6 +104,19 @@ class Node(Service):
         self.node_key = NodeKey.load_or_generate(config.node_key_file)
         self.priv_validator = FilePV.load_or_generate(
             config.priv_validator_key_file, config.priv_validator_state_file
+        )
+
+        # --- BLS dual-signing key (node.go:106-113: the reference loads
+        # blssignatures.KeyFile at startup and refuses to run without it).
+        # Loaded (or generated, like the other key files) so the assembled
+        # node actually dual-signs batch-point precommits.
+        from ..crypto import bls_native, bls_signatures as bls
+
+        bls_native.native_lib()  # build/load the C++ pairing NOW, not on
+        # the event loop mid-consensus (first call may invoke g++)
+        self.bls_key = bls.load_or_gen_bls_key(config.bls_key_file)
+        self.bls_signer = bls.signer_for(
+            bls.priv_key_from_bytes(self.bls_key.priv_key)
         )
 
         # --- genesis + state (node.go:797-805) ---
@@ -202,6 +218,7 @@ class Node(Service):
             self.block_store,
             l2_node,
             priv_validator=self.priv_validator,
+            bls_signer=self.bls_signer,
             event_bus=self.event_bus,
             wal=wal,
             upgrade_height=config.consensus.switch_height,

@@ -299,3 +299,53 @@ def test_prometheus_metrics_served(tmp_path):
         if ln.startswith("tendermint_consensus_height ")
     ][0]
     assert float(line.split()[-1]) >= 2
+
+
+def test_node_commits_batch_point_with_bls(tmp_path):
+    """VERDICT r2 item-1 'done' criterion: an ASSEMBLED Node (not a
+    hand-wired ConsensusState) dual-signs batch-point precommits with the
+    BLS key loaded from config.bls_key_file, the L2 node verifies them,
+    and CommitBatch receives BLS data whose aggregate verifies."""
+    from tendermint_tpu.crypto import bls_signatures as bls
+    from tendermint_tpu.l2node.mock import MockL2Node
+    from tendermint_tpu.privval.file_pv import FilePV
+
+    cfg = make_test_config(tmp_path)
+    init_files(cfg)
+
+    # the L2 side knows the staked BLS keys ahead of time (the real Morph
+    # node resolves them from the sequencer-set contract)
+    key = bls.load_or_gen_bls_key(cfg.bls_key_file)
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_file, cfg.priv_validator_state_file
+    )
+    registry = bls.BLSKeyRegistry()
+    registry.register(
+        pv.get_pub_key().data,
+        bls.public_key_from_bytes(key.pub_key, trusted_source=True),
+    )
+    l2 = MockL2Node(
+        batch_blocks_interval=2,
+        bls_verifier=registry.verifier(),
+        bls_batch_verifier=registry.batch_verifier(),
+    )
+    node = Node(cfg, l2_node=l2)
+
+    async def run():
+        await node.start()
+        try:
+            await node.consensus.wait_for_height(4, timeout=90)
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+    assert l2.committed_batches, "no batch committed through the node"
+    batch_hash, bls_datas = l2.committed_batches[0]
+    assert bls_datas, "batch committed without BLS data"
+    pub = bls.public_key_from_bytes(key.pub_key, trusted_source=True)
+    sigs = [bls.g1_from_bytes(d.signature) for d in bls_datas]
+    agg = bls.aggregate_signatures(sigs)
+    assert bls.verify_aggregated_same_message(
+        agg, batch_hash, [pub] * len(sigs)
+    )
