@@ -57,14 +57,20 @@ class HeightVoteSet:
     def add_vote(
         self, vote: Vote, peer_id: str = "", verified: bool = False
     ) -> bool:
-        """Returns True if added. Rounds beyond current+1 are only created
-        for peers that earned them via SetPeerMaj23 (reference addVote)."""
+        """Returns True if added. A round beyond current+1 is GRANTED on
+        first vote arrival, up to MAX_CATCHUP_ROUNDS per peer (reference
+        height_vote_set.go addVote: peerCatchupRounds — this is how a
+        restarted node at round 0 accepts the commit's round-2 precommits
+        during gossip catchup; requiring a prior maj23 claim here deadlocks
+        exactly that recovery path)."""
         if vote.round > self.round + 1:
-            rounds = self._peer_catchup_rounds.get(peer_id, [])
+            rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
             if vote.round not in rounds:
-                raise ValueError(
-                    "unexpected round in peer vote (no maj23 claim)"
-                )
+                if len(rounds) >= self.MAX_CATCHUP_ROUNDS:
+                    raise ValueError(
+                        "peer sent votes for too many catchup rounds"
+                    )
+                rounds.append(vote.round)
         self._ensure_round(vote.round)
         return self._rounds[vote.round][vote.type].add_vote(
             vote, verified=verified

@@ -48,7 +48,7 @@ def native_lib() -> Optional[ctypes.CDLL]:
             os.path.exists(src)
             and os.path.getmtime(src) > os.path.getmtime(so_path)
         ):
-            if not os.path.exists(src):
+            if not os.path.exists(src) and not os.path.exists(so_path):
                 return None
             try:
                 subprocess.run(
@@ -58,7 +58,10 @@ def native_lib() -> Optional[ctypes.CDLL]:
                     timeout=180,
                 )
             except (subprocess.SubprocessError, OSError):
-                return None
+                # rebuild failed (no compiler?): an existing .so — e.g.
+                # checked out with arbitrary mtimes — is still usable
+                if not os.path.exists(so_path):
+                    return None
         try:
             lib = ctypes.CDLL(so_path)
             for name in _FUNCS:

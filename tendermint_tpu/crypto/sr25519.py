@@ -107,9 +107,16 @@ class PrivKey:
 
     @classmethod
     def generate(cls) -> "PrivKey":
+        """Canonical 32-byte secret (< L): a uniform 512-bit value reduced
+        mod L, encoded little-endian. Raw token_bytes would be >= L with
+        ~94% probability and be rejected by reference-compatible software
+        (go-schnorrkel NewMiniSecretKeyFromRaw canonical decode; the
+        reference's genPrivKey emits ExpandEd25519().Encode(), also a
+        canonical scalar — crypto/sr25519/privkey.go:83-97)."""
         import secrets
 
-        return cls(secrets.token_bytes(32))
+        k = int.from_bytes(secrets.token_bytes(64), "little") % L
+        return cls(k.to_bytes(32, "little"))
 
     @classmethod
     def from_secret(cls, seed: bytes) -> "PrivKey":

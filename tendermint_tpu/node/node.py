@@ -110,10 +110,13 @@ class Node(Service):
         # blssignatures.KeyFile at startup and refuses to run without it).
         # Loaded (or generated, like the other key files) so the assembled
         # node actually dual-signs batch-point precommits.
-        from ..crypto import bls_native, bls_signatures as bls
+        from ..crypto import bls_native, secp_native
+        from ..crypto import bls_signatures as bls
 
-        bls_native.native_lib()  # build/load the C++ pairing NOW, not on
-        # the event loop mid-consensus (first call may invoke g++)
+        # build/load the native crypto NOW, not on the event loop
+        # mid-consensus (the first call may invoke g++ for seconds)
+        bls_native.native_lib()
+        secp_native.native_lib()
         self.bls_key = bls.load_or_gen_bls_key(config.bls_key_file)
         self.bls_signer = bls.signer_for(
             bls.priv_key_from_bytes(self.bls_key.priv_key)

@@ -15,6 +15,26 @@ from typing import Optional
 from ..types.event_bus import Query
 
 
+def _from_hex(value, what: str = "hash", required: bool = False) -> bytes:
+    """Parse a hex string param (optional 0x prefix) into bytes, raising a
+    clean JSON-RPC invalid-params error instead of a bare ValueError.
+    `required` distinguishes a mandatory param (tx hash, evidence) from a
+    genuinely optional one (header_by_hash's empty lookup)."""
+    if not value:
+        if required:
+            from .server import RPCError
+
+            raise RPCError(-32602, f"missing required param: {what}")
+        return b""
+    s = value[2:] if isinstance(value, str) and value.startswith("0x") else value
+    try:
+        return bytes.fromhex(s)
+    except (ValueError, TypeError):
+        from .server import RPCError
+
+        raise RPCError(-32602, f"invalid {what}: not hex") from None
+
+
 def _hex(b: bytes) -> str:
     return b.hex().upper()
 
@@ -195,7 +215,7 @@ class RPCCore:
     def header_by_hash(self, hash=None, **_kw) -> dict:
         """Block header by block hash (reference routes.go:28)."""
         bs = self.node.block_store
-        h_bytes = bytes.fromhex(hash) if hash else b""
+        h_bytes = _from_hex(hash)
         blk = bs.load_block_by_hash(h_bytes)
         if blk is None:
             from .server import RPCError
@@ -219,7 +239,7 @@ class RPCCore:
 
     def block_by_hash(self, hash=None, **_kw) -> dict:
         bs = self.node.block_store
-        h_bytes = bytes.fromhex(hash) if hash else b""
+        h_bytes = _from_hex(hash)
         blk = bs.load_block_by_hash(h_bytes)
         if blk is None:
             from .server import RPCError
@@ -347,7 +367,7 @@ class RPCCore:
             from .server import RPCError
 
             raise RPCError(-32000, "tx indexing is disabled")
-        res = idx.get_tx(bytes.fromhex(hash))
+        res = idx.get_tx(_from_hex(hash, required=True))
         if res is None:
             from .server import RPCError
 
@@ -396,7 +416,7 @@ class RPCCore:
 
     def abci_query(self, path="", data="", height=0, prove=False, **_kw):
         res = self.node.app.query(
-            path, bytes.fromhex(data) if data else b"", int(height), bool(prove)
+            path, _from_hex(data, "data"), int(height), bool(prove)
         )
         return {
             "response": {
@@ -411,7 +431,7 @@ class RPCCore:
     def broadcast_evidence(self, evidence="", **_kw) -> dict:
         from ..types.evidence import decode_evidence
 
-        ev = decode_evidence(bytes.fromhex(evidence))
+        ev = decode_evidence(_from_hex(evidence, "evidence", required=True))
         self.node.evidence_pool.add_evidence(ev)
         return {"hash": _hex(ev.hash())}
 

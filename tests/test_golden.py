@@ -1,0 +1,209 @@
+"""Golden wire vectors — pin every signing/hashing encoding to committed
+fixtures so a refactor cannot silently change sign-bytes or hashes and
+fork the chain from itself.
+
+The framework deliberately defines its own wire (types/block.py:14-16);
+this is the price: nothing external pins the encodings, so these vectors
+do (the reference pins via protobuf + spec — types/canonical.go:18-57,
+spec/core/encoding.md).
+
+Regenerate deliberately after an INTENTIONAL wire change with:
+    GOLDEN_REGEN=1 python -m pytest tests/test_golden.py
+and commit the diff. A failure here without an intentional change means
+the encoding drifted — that is a consensus-breaking bug, not a stale
+fixture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tendermint_tpu.consensus.wal import (
+    KIND_END_HEIGHT,
+    WALMessage,
+    encode_record,
+)
+from tendermint_tpu.libs import protoio as pio
+from tendermint_tpu.types.block import (
+    Block,
+    BlockIDFlag,
+    Commit,
+    CommitSig,
+    Data,
+    Header,
+)
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote, VoteType
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "golden_vectors.json")
+
+CHAIN_ID = "golden-chain"
+T0 = 1_700_000_000_123_456_789
+ADDR = bytes(range(20))
+HASH32 = bytes(range(32))
+HASH32B = bytes(range(1, 33))
+
+
+def _block_id() -> BlockID:
+    return BlockID(HASH32, PartSetHeader(3, HASH32B))
+
+
+def _vote(vtype, bls: bool = False) -> Vote:
+    return Vote(
+        type=vtype,
+        height=12345,
+        round=2,
+        block_id=_block_id(),
+        timestamp_ns=T0,
+        validator_address=ADDR,
+        validator_index=7,
+        signature=bytes(64),
+        bls_signature=b"\xbb" * 96 if bls else b"",
+    )
+
+
+def _nil_vote() -> Vote:
+    return Vote(
+        type=VoteType.PREVOTE,
+        height=12345,
+        round=0,
+        block_id=BlockID(),
+        timestamp_ns=T0,
+        validator_address=ADDR,
+        validator_index=0,
+        signature=bytes(64),
+    )
+
+
+def _proposal() -> Proposal:
+    return Proposal(
+        height=12345,
+        round=2,
+        pol_round=-1,
+        block_id=_block_id(),
+        timestamp_ns=T0,
+        signature=bytes(64),
+    )
+
+
+def _commit() -> Commit:
+    return Commit(
+        height=12344,
+        round=1,
+        block_id=_block_id(),
+        signatures=[
+            CommitSig(BlockIDFlag.COMMIT, ADDR, T0, b"\x01" * 64),
+            CommitSig(BlockIDFlag.NIL, bytes(reversed(ADDR)), T0, b"\x02" * 64),
+            CommitSig.absent(),
+            CommitSig(
+                BlockIDFlag.COMMIT,
+                ADDR,
+                T0,
+                b"\x03" * 64,
+                bls_signature=b"\xbb" * 96,
+            ),
+        ],
+    )
+
+
+def _header(batch: bool = False) -> Header:
+    return Header(
+        chain_id=CHAIN_ID,
+        height=12345,
+        time_ns=T0,
+        last_block_id=_block_id(),
+        last_commit_hash=HASH32,
+        data_hash=HASH32B,
+        validators_hash=HASH32,
+        next_validators_hash=HASH32B,
+        consensus_hash=HASH32,
+        app_hash=b"\xaa" * 32,
+        last_results_hash=HASH32,
+        evidence_hash=HASH32B,
+        proposer_address=ADDR,
+        batch_hash=HASH32 if batch else b"",
+    )
+
+
+def _block() -> Block:
+    return Block(
+        header=_header(batch=True),
+        data=Data(
+            txs=[b"tx-one", b"tx-two=value", b""],
+            l2_block_meta=b"l2meta:\x01\x02",
+            l2_batch_header=b"batch-header-bytes",
+        ),
+        last_commit=_commit(),
+    )
+
+
+def compute_vectors() -> dict:
+    v = _vote(VoteType.PRECOMMIT, bls=True)
+    nv = _nil_vote()
+    pv = _vote(VoteType.PREVOTE)
+    prop = _proposal()
+    commit = _commit()
+    block = _block()
+    parts = block.make_part_set()
+    wal_msgs = [
+        encode_record(WALMessage("vote", b"payload-bytes", timestamp_ns=T0)),
+        encode_record(
+            WALMessage(
+                KIND_END_HEIGHT, pio.write_uvarint(12345), timestamp_ns=T0
+            )
+        ),
+    ]
+    vec = {
+        "vote_sign_bytes_precommit": v.sign_bytes(CHAIN_ID).hex(),
+        "vote_sign_bytes_prevote": pv.sign_bytes(CHAIN_ID).hex(),
+        "vote_sign_bytes_nil": nv.sign_bytes(CHAIN_ID).hex(),
+        "vote_encode": v.encode().hex(),
+        "proposal_sign_bytes": prop.sign_bytes(CHAIN_ID).hex(),
+        "proposal_encode": prop.encode().hex(),
+        "commit_hash": commit.hash().hex(),
+        "commit_encode": commit.encode().hex(),
+        "header_hash": _header().hash().hex(),
+        "header_hash_batch_point": _header(batch=True).hash().hex(),
+        "block_hash": block.hash().hex(),
+        "block_encode": block.encode().hex(),
+        "part_set_header_hash": parts.header.hash.hex(),
+        "part0_encode": parts.get_part(0).encode().hex(),
+        "wal_record_msg": wal_msgs[0].hex(),
+        "wal_record_end_height": wal_msgs[1].hex(),
+        "block_id_encode": _block_id().encode().hex(),
+    }
+    return vec
+
+
+def test_golden_vectors():
+    got = compute_vectors()
+    if os.environ.get("GOLDEN_REGEN") == "1" or not os.path.exists(FIXTURE):
+        with open(FIXTURE, "w") as f:
+            json.dump(got, f, indent=2, sort_keys=True)
+            f.write("\n")
+    with open(FIXTURE) as f:
+        want = json.load(f)
+    assert set(got) == set(want), (
+        f"vector set changed: +{set(got) - set(want)} -{set(want) - set(got)}"
+    )
+    for k in sorted(want):
+        assert got[k] == want[k], (
+            f"WIRE DRIFT in {k}:\n  fixture: {want[k][:80]}...\n"
+            f"  current: {got[k][:80]}...\n"
+            "If this change is intentional, regenerate with GOLDEN_REGEN=1 "
+            "and note the consensus break."
+        )
+
+
+def test_golden_roundtrips():
+    """The pinned encodings must also decode back to equal values."""
+    v = _vote(VoteType.PRECOMMIT, bls=True)
+    assert Vote.decode(v.encode()) == v
+    prop = _proposal()
+    assert Proposal.decode(prop.encode()) == prop
+    commit = _commit()
+    assert Commit.decode(commit.encode()).hash() == commit.hash()
+    block = _block()
+    assert Block.decode(block.encode()).hash() == block.hash()

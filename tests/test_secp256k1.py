@@ -124,3 +124,74 @@ def test_mixed_key_commit_verifies():
 
     with pytest.raises(ValueError, match="wrong signature"):
         vs.verify_commit(CHAIN_ID, bid, 5, commit, verifier=verifier)
+
+
+def test_native_batch_matches_python():
+    """crypto/secp_native batched Shamir path must agree with the pure
+    Python verifier on valid, corrupted, wrong-key, high-S, and malformed
+    inputs (BASELINE config 4's secp rows)."""
+    from tendermint_tpu.crypto import secp256k1 as s
+    from tendermint_tpu.crypto import secp_native
+
+    privs = [s.PrivKey.from_secret(b"nb%d" % i) for i in range(12)]
+    msgs = [b"m-%d" % i for i in range(12)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    pubs = [p.public_key().data for p in privs]
+
+    cases = list(zip(pubs, msgs, sigs))
+    # corrupted signature byte
+    cases.append((pubs[0], msgs[0], sigs[0][:10] + b"\xff" + sigs[0][11:]))
+    # wrong message
+    cases.append((pubs[1], b"other", sigs[1]))
+    # wrong key
+    cases.append((pubs[2], msgs[3], sigs[3]))
+    # high-S (forge malleated sig: s' = N - s)
+    r_b, s_b = sigs[4][:32], sigs[4][32:]
+    s_int = int.from_bytes(s_b, "big")
+    cases.append(
+        (pubs[4], msgs[4], r_b + (s.N - s_int).to_bytes(32, "big"))
+    )
+    # malformed length
+    cases.append((pubs[5], msgs[5], b"\x01" * 63))
+
+    got = secp_native.verify_msgs_batch(
+        [c[0] for c in cases], [c[1] for c in cases], [c[2] for c in cases]
+    )
+    want = [
+        s.PubKey(c[0]).verify(c[1], c[2]) if len(c[2]) == 64 else False
+        for c in cases
+    ]
+    assert got == want
+    assert got[:12] == [True] * 12
+    assert got[12:] == [False] * 5
+
+
+def test_mixed_key_batch_verifier_uses_native_secp():
+    """BatchVerifier partitions mixed ed25519/secp256k1 rows; the secp
+    rows go through the batched native call and re-interleave correctly."""
+    from tendermint_tpu.crypto import ed25519, secp256k1 as s
+    from tendermint_tpu.crypto.batch_verifier import BatchVerifier, SigItem
+
+    items = []
+    want = []
+    for i in range(6):
+        if i % 2 == 0:
+            priv = s.PrivKey.from_secret(b"mix%d" % i)
+            msg = b"mixed-%d" % i
+            sig = priv.sign(msg)
+            if i == 4:
+                sig = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
+            items.append(
+                SigItem(priv.public_key().data, msg, sig, "secp256k1")
+            )
+            want.append(i != 4)
+        else:
+            sk = ed25519.PrivKey(b"e" * 31 + bytes([i]))
+            msg = b"edrow-%d" % i
+            items.append(
+                SigItem(sk.public_key().data, msg, sk.sign(msg), "ed25519")
+            )
+            want.append(True)
+    v = BatchVerifier()
+    out = list(v.verify(items))
+    assert out == want

@@ -99,3 +99,50 @@ def test_pubkey_deterministic_and_sized():
     p1, p2 = k.public_key(), k.public_key()
     assert p1 == p2 and len(p1.data) == 32
     assert len(k.public_key().address()) == 20
+
+
+def test_sr25519_verify_golden_fixture():
+    """Pin signature VERIFICATION behavior against a committed fixture.
+
+    No cross-implementation KAT is possible in this offline environment
+    (no schnorrkel build anywhere in the image, and the reference's
+    sr25519_test.go ships no vectors — only sign/verify round-trips); the
+    merlin transcript and ristretto255 layers below this ARE vector-tested
+    against their published RFC/conformance vectors. This fixture freezes
+    our transcript flow ("substrate" ctx labels, witness derivation) so an
+    accidental change to sign/verify internals fails loudly instead of
+    silently rejecting real-world signatures after a refactor."""
+    import json
+    import os
+
+    from tendermint_tpu.crypto import sr25519
+
+    path = os.path.join(
+        os.path.dirname(__file__), "sr25519_golden.json"
+    )
+    priv = sr25519.PrivKey.from_secret(b"golden-seed")
+    msg = b"golden message"
+    pub = priv.public_key()
+    if not os.path.exists(path):
+        # deterministic signature: sign uses a transcript-derived witness
+        # with external randomness; for the fixture we need stability, so
+        # record pub + a signature produced NOW and only pin VERIFY.
+        sig = priv.sign(msg)
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "pub": pub.data.hex(),
+                    "msg": msg.hex(),
+                    "sig": sig.hex(),
+                },
+                f,
+                indent=2,
+            )
+    with open(path) as f:
+        d = json.load(f)
+    assert bytes.fromhex(d["pub"]) == pub.data, (
+        "key derivation drifted: the same seed produces a different pubkey"
+    )
+    assert sr25519.PubKey(bytes.fromhex(d["pub"])).verify(
+        bytes.fromhex(d["msg"]), bytes.fromhex(d["sig"])
+    ), "verify no longer accepts a signature produced by an earlier build"
