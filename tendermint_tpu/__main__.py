@@ -509,6 +509,10 @@ def main(argv=None) -> int:
     )
     sp.set_defaults(fn=cmd_replay, console=True)
 
+    from .abci.cli import register as register_abci_cli
+
+    register_abci_cli(sub)
+
     sp = sub.add_parser("rewind", help="rewind state+blocks to a height")
     sp.add_argument("--height", type=int, required=True)
     sp.set_defaults(fn=cmd_rewind)

@@ -28,10 +28,17 @@ class BaseConfig:
     priv_validator_laddr: str = ""  # remote signer listen addr
     node_key_file: str = "config/node_key.json"
     bls_key_file: str = "config/bls_key.json"
+    # batches >= this size compute SHA-512 vote challenges ON DEVICE
+    # (fused into the verify program) instead of on the host hashing
+    # thread. 0 = host hashing. Enable (e.g. 2048) on real silicon where
+    # the device outruns one CPU core's hashlib (~600k sigs/s).
+    device_challenge_min: int = 0
 
     def validate_basic(self) -> None:
         if self.db_backend not in ("sqlite", "memory"):
             raise ValueError(f"unknown db_backend {self.db_backend!r}")
+        if self.device_challenge_min < 0:
+            raise ValueError("device_challenge_min must be >= 0")
 
 
 @dataclass

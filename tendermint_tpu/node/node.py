@@ -117,6 +117,13 @@ class Node(Service):
         # mid-consensus (the first call may invoke g++ for seconds)
         bls_native.native_lib()
         secp_native.native_lib()
+        # export the fused device-SHA-512 knob before the first
+        # default_verifier() constructs the process-wide verifier
+        if config.base.device_challenge_min > 0:
+            os.environ.setdefault(
+                "TM_TPU_DEVICE_CHALLENGE_MIN",
+                str(config.base.device_challenge_min),
+            )
         self.bls_key = bls.load_or_gen_bls_key(config.bls_key_file)
         self.bls_signer = bls.signer_for(
             bls.priv_key_from_bytes(self.bls_key.priv_key)

@@ -353,7 +353,16 @@ def scalar_mult_var_bigcache(
 
     Gathers one window-row slice per iteration ([cap, 16, 4, 32] sliced,
     then a [B]-gather of the selected digit entries) so the full 512 KiB
-    per-key tables are never materialized per batch element."""
+    per-key tables are never materialized per batch element.
+
+    Measured dead end (r3, keep for the record): splitting the 64
+    sequential window-adds into C independent chains + a log-tree merge
+    (depth 64 -> 64/C + log2 C) REGRESSED 3x on the harness executor
+    (B=8192: 137 ms -> 402 ms) — the per-step multi-axis gather
+    tables[idx, w, dig] over [B, C] lowers to a generalized gather far
+    costlier than this loop's slice + single-axis gather. Latency here is
+    gather-bound, not dispatch-depth-bound; revisit only with a Pallas
+    kernel that keeps the window tables in VMEM."""
     digs = nibbles(scalar_bytes)  # [B, 64] LSB-first
 
     def body(i, acc):

@@ -1,121 +1,25 @@
-"""PEX — peer exchange + persistent address book.
+"""PEX — peer exchange reactor over the hashed-bucket address book.
 
-Reference: p2p/pex/ (addrbook.go with old/new buckets, pex_reactor.go,
-seed-mode crawl). The address book here keeps the same observable behavior
-— persistent JSON, markGood/markAttempt, pick for dialing — with a single
-scored table instead of the reference's 256+64 hashed buckets.
+Reference: p2p/pex/pex_reactor.go (+ seed-mode crawl). The address book
+itself lives in p2p/addrbook.py — 256+64 hashed buckets with
+eviction/promotion, the reference's eclipse-resistance structure
+(addrbook.go:1-947); round 2's flat scored table is gone.
 """
 
 from __future__ import annotations
 
 import asyncio
-import json
-import os
 import secrets
-import time
-from dataclasses import dataclass, field
 from typing import Optional
 
 from ..libs import protoio as pio
 from ..libs.log import nop_logger
+from .addrbook import AddrBook, KnownAddress  # noqa: F401 (re-export)
 from .mconn import ChannelDescriptor
 from .switch import Reactor
 from .transport import NetAddress, Peer
 
 PEX_CHANNEL = 0x00
-
-
-@dataclass
-class KnownAddress:
-    addr: str  # "id@host:port"
-    attempts: int = 0
-    last_attempt: float = 0.0
-    last_success: float = 0.0
-    bucket: str = "new"  # "new" | "old" (old = proven good)
-
-
-class AddrBook:
-    def __init__(self, path: str = "", our_id: str = ""):
-        self._path = path
-        self._our_id = our_id
-        self._addrs: dict[str, KnownAddress] = {}  # node id -> entry
-        if path and os.path.exists(path):
-            self._load()
-
-    def add_address(self, addr: NetAddress) -> bool:
-        if not addr.id or addr.id == self._our_id:
-            return False
-        if addr.id in self._addrs:
-            return False
-        self._addrs[addr.id] = KnownAddress(addr=str(addr))
-        return True
-
-    def mark_attempt(self, node_id: str) -> None:
-        ka = self._addrs.get(node_id)
-        if ka:
-            ka.attempts += 1
-            ka.last_attempt = time.time()
-
-    def mark_good(self, node_id: str) -> None:
-        ka = self._addrs.get(node_id)
-        if ka:
-            ka.attempts = 0
-            ka.last_success = time.time()
-            ka.bucket = "old"
-
-    def remove_address(self, node_id: str) -> None:
-        self._addrs.pop(node_id, None)
-
-    def pick_address(self, exclude: set[str]) -> Optional[NetAddress]:
-        """Biased pick: prefer old (proven) addresses, avoid many-failures."""
-        candidates = [
-            ka
-            for nid, ka in self._addrs.items()
-            if nid not in exclude and ka.attempts < 10
-        ]
-        if not candidates:
-            return None
-        old = [ka for ka in candidates if ka.bucket == "old"]
-        pool = old if old and secrets.randbelow(100) < 70 else candidates
-        return NetAddress.parse(pool[secrets.randbelow(len(pool))].addr)
-
-    def get_selection(self, max_n: int = 30) -> list[NetAddress]:
-        addrs = [NetAddress.parse(ka.addr) for ka in self._addrs.values()]
-        secrets.SystemRandom().shuffle(addrs)
-        return addrs[:max_n]
-
-    def size(self) -> int:
-        return len(self._addrs)
-
-    def save(self) -> None:
-        if not self._path:
-            return
-        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
-        with open(self._path, "w") as f:
-            json.dump(
-                {
-                    nid: {
-                        "addr": ka.addr,
-                        "attempts": ka.attempts,
-                        "bucket": ka.bucket,
-                        "last_success": ka.last_success,
-                    }
-                    for nid, ka in self._addrs.items()
-                },
-                f,
-                indent=2,
-            )
-
-    def _load(self) -> None:
-        with open(self._path) as f:
-            data = json.load(f)
-        for nid, d in data.items():
-            self._addrs[nid] = KnownAddress(
-                addr=d["addr"],
-                attempts=d.get("attempts", 0),
-                bucket=d.get("bucket", "new"),
-                last_success=d.get("last_success", 0.0),
-            )
 
 
 # --- pex reactor ----------------------------------------------------------
@@ -198,9 +102,17 @@ class PEXReactor(Reactor):
                 await asyncio.sleep(0.1)
                 await self.switch.stop_peer_gracefully(peer)
         elif kind == _MSG_ADDRS:
+            try:
+                src_addr = NetAddress.parse(
+                    f"{peer.id}@{peer.node_info.listen_addr}"
+                ) if peer.node_info.listen_addr else None
+            except ValueError:
+                src_addr = None
             for raw in f.get(2, []):
                 try:
-                    self.book.add_address(NetAddress.parse(raw.decode()))
+                    self.book.add_address(
+                        NetAddress.parse(raw.decode()), src=src_addr
+                    )
                 except (ValueError, UnicodeDecodeError):
                     await self.switch.stop_peer_for_error(
                         peer, "malformed pex address"

@@ -107,3 +107,70 @@ def test_debug_server_endpoints(tmp_path):
         await node.stop()
 
     asyncio.run(run())
+
+
+def test_abci_cli_against_kvstore_server():
+    """abci-cli parity (reference abci/cmd/abci-cli): spawn the kvstore
+    app server as a SEPARATE process, drive echo/deliver_tx/commit/query
+    through the CLI over the socket protocol."""
+    import socket as socket_mod
+    import subprocess
+    import sys
+    import time
+
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    srv = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "tendermint_tpu",
+            "abci-cli",
+            "kvstore",
+            "--port",
+            str(port),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        # wait for the listening line (bounded: readline on a pipe blocks
+        # forever if the server hangs pre-print)
+        import select
+
+        ready, _, _ = select.select([srv.stdout], [], [], 60)
+        assert ready, "kvstore server never printed its listening line"
+        line = srv.stdout.readline().decode()
+        assert "listening" in line, line
+
+        def cli(*args):
+            return subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "tendermint_tpu",
+                    "abci-cli",
+                    *args,
+                    "--port",
+                    str(port),
+                ],
+                capture_output=True,
+                timeout=60,
+            )
+
+        r = cli("echo", "hello-abci")
+        assert r.returncode == 0 and b"hello-abci" in r.stdout
+        r = cli("deliver_tx", "mykey=myvalue")
+        assert r.returncode == 0 and b"code=0" in r.stdout
+        r = cli("commit")
+        assert r.returncode == 0 and b"data=0x" in r.stdout
+        r = cli("query", "mykey")
+        assert r.returncode == 0 and b"myvalue" in r.stdout
+        r = cli("info")
+        assert r.returncode == 0 and b"kvstore" in r.stdout
+    finally:
+        srv.kill()
+        srv.wait(timeout=10)

@@ -252,3 +252,89 @@ def test_pex_gossip_discovers_peers():
             await sw.stop()
 
     asyncio.run(run())
+
+
+def test_addrbook_hashed_buckets_and_promotion(tmp_path):
+    """Hashed-bucket address book (reference addrbook.go): placement is
+    bucketed by keyed hash, markGood promotes NEW->OLD, old entries are
+    only displaced by demotion, and the book round-trips through disk."""
+    from tendermint_tpu.p2p.addrbook import AddrBook
+    from tendermint_tpu.p2p.transport import NetAddress
+
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path, our_id="f" * 40)
+
+    def addr(i, host="10.%d.%d.1"):
+        nid = ("%040x" % i)
+        return NetAddress.parse(f"{nid}@{host % (i % 250, i % 200)}:26656")
+
+    src = addr(9999, host="172.16.%d.%d")
+    for i in range(200):
+        assert book.add_address(addr(i), src=src)
+    assert book.size() == 200
+    assert book.n_new() == 200 and book.n_old() == 0
+    # addresses from ONE source group concentrate in <= 32 new buckets
+    used = sum(1 for b in book._new if b)
+    assert used <= 32, f"one source spread over {used} buckets"
+
+    # re-adding our own id / duplicates is refused
+    assert not book.add_address(
+        NetAddress.parse(("f" * 40) + "@1.2.3.4:1"), src=src
+    )
+
+    # promotion: proven peers move to old buckets and survive floods
+    for i in range(50):
+        book.mark_good("%040x" % i)
+    assert book.n_old() == 50
+    for i in range(1000, 1400):
+        book.add_address(addr(i), src=src)
+    assert book.n_old() == 50  # flood displaced no proven peer
+
+    # pick with heavy old bias returns a proven address
+    picked = book.pick_address(exclude=set(), bias_new=0)
+    assert picked is not None
+    assert int(picked.id, 16) < 50
+
+    book.save()
+    book2 = AddrBook(path, our_id="f" * 40)
+    assert book2.size() == book.size()
+    assert book2.n_old() == 50
+
+
+def test_trust_metric_pd_behavior():
+    """PD trust metric (reference p2p/trust/metric.go): perfect history
+    stays 1.0; bad bursts drop the score immediately (falling derivative
+    weight 1.0); recovery is gradual through the integral term."""
+    from tendermint_tpu.p2p.trust import TrustMetric, TrustMetricStore
+
+    tm = TrustMetric()
+    for _ in range(10):
+        tm.good_event()
+        tm.tick()
+    assert tm.value() > 0.99
+
+    # a burst of bad behavior: immediate drop below 0.6
+    for _ in range(20):
+        tm.bad_event()
+    v_after_bad = tm.value()
+    assert v_after_bad < 0.6
+    tm.tick()
+
+    # recovery is monotone but not instant
+    vals = []
+    for _ in range(6):
+        for _ in range(5):
+            tm.good_event()
+        tm.tick()
+        vals.append(tm.value())
+    assert vals[-1] > vals[0] > v_after_bad
+    assert vals[-1] < 1.0  # the bad interval still echoes in history
+
+    # store: pause on disconnect freezes counting; persistence roundtrip
+    store = TrustMetricStore()
+    m = store.get_metric("peer1")
+    m.bad_event()
+    store.peer_disconnected("peer1")
+    frozen = m.value()
+    store.tick_all()
+    assert m.value() == frozen
