@@ -1,0 +1,118 @@
+------------------------- MODULE ConsensusSafety -------------------------
+(***************************************************************************)
+(* Safety core of the consensus voting rules as implemented by             *)
+(* tendermint_tpu/consensus/state_machine.py: POL locking (:660-725),      *)
+(* the 2/3 precommit commit rule, and unlock-on-higher-POL.                *)
+(*                                                                         *)
+(* Reference counterpart: spec/consensus/consensus-paper/ (the arXiv       *)
+(* algorithm) + spec/ivy-proofs/.  This module re-states the two safety    *)
+(* invariants the implementation relies on; it is NOT a full protocol      *)
+(* model (timeouts and the proposer function are abstracted away — they    *)
+(* affect liveness, not safety).                                           *)
+(*                                                                         *)
+(* Status: syntax-complete TLA+, NOT model-checked in this build           *)
+(* environment (no TLC/Apalache in the image — see spec/tla/README.md).    *)
+(***************************************************************************)
+
+EXTENDS Integers, FiniteSets
+
+CONSTANTS
+  VALIDATORS,   \* identities, equal voting power (power sums abstract to counts)
+  FAULTY,       \* byzantine subset; < 1/3 assumed for the theorems
+  ROUNDS,       \* 0..Rmax
+  VALUES        \* proposable block values (+ Nil below)
+
+Nil == CHOOSE v : v \notin VALUES
+
+Honest == VALIDATORS \ FAULTY
+
+TwoThirds(S) == 3 * Cardinality(S) > 2 * Cardinality(VALIDATORS)
+
+VARIABLES
+  prevotes,    \* [ROUNDS -> [VALIDATORS -> VALUES \union {Nil}]] partial
+  precommits,  \* same shape
+  locked       \* [VALIDATORS -> [val: VALUES \union {Nil}, round: Int]]
+
+vars == <<prevotes, precommits, locked>>
+
+NoVote == CHOOSE v : v \notin VALUES \union {Nil}
+
+Init ==
+  /\ prevotes   = [r \in ROUNDS |-> [v \in VALIDATORS |-> NoVote]]
+  /\ precommits = [r \in ROUNDS |-> [v \in VALIDATORS |-> NoVote]]
+  /\ locked     = [v \in VALIDATORS |-> [val |-> Nil, round |-> -1]]
+
+PolkaAt(r, val) ==
+  TwoThirds({v \in VALIDATORS : prevotes[r][v] = val})
+
+(***************************************************************************)
+(* Honest-validator rules (state_machine.py):                              *)
+(*  - a locked validator prevotes only its lock, unless a polka at a      *)
+(*    higher round releases it (_enter_prevote + POL unlock :660-725);    *)
+(*  - precommit val at r only on a polka for val at r (_on_prevote_added);*)
+(*  - precommitting sets the lock to (val, r).                            *)
+(* Faulty validators vote arbitrarily (including equivocation, modeled    *)
+(* by overwriting).                                                        *)
+(***************************************************************************)
+
+HonestPrevote(v, r, val) ==
+  /\ v \in Honest
+  /\ prevotes[r][v] = NoVote
+  /\ \/ locked[v].val = Nil
+     \/ locked[v].val = val
+     \/ \E pr \in ROUNDS :
+          pr > locked[v].round /\ pr < r /\ PolkaAt(pr, val)
+  /\ prevotes' = [prevotes EXCEPT ![r][v] = val]
+  /\ UNCHANGED <<precommits, locked>>
+
+HonestPrecommit(v, r, val) ==
+  /\ v \in Honest
+  /\ precommits[r][v] = NoVote
+  /\ val \in VALUES => PolkaAt(r, val)
+  /\ precommits' = [precommits EXCEPT ![r][v] = val]
+  /\ locked' =
+       IF val \in VALUES
+       THEN [locked EXCEPT ![v] = [val |-> val, round |-> r]]
+       ELSE locked
+  /\ UNCHANGED prevotes
+
+ByzantineVote(v, r, val) ==
+  /\ v \in FAULTY
+  /\ \/ prevotes'   = [prevotes EXCEPT ![r][v] = val] /\ UNCHANGED precommits
+     \/ precommits' = [precommits EXCEPT ![r][v] = val] /\ UNCHANGED prevotes
+  /\ UNCHANGED locked
+
+Next ==
+  \E v \in VALIDATORS, r \in ROUNDS, val \in VALUES \union {Nil} :
+    HonestPrevote(v, r, val) \/ HonestPrecommit(v, r, val)
+      \/ ByzantineVote(v, r, val)
+
+Spec == Init /\ [][Next]_vars
+
+(***************************************************************************)
+(* Theorems (the invariants state_machine.py's commit rule rests on)       *)
+(***************************************************************************)
+
+Decided(r, val) ==
+  val \in VALUES /\ TwoThirds({v \in VALIDATORS : precommits[r][v] = val})
+
+FaultAssumption == 3 * Cardinality(FAULTY) < Cardinality(VALIDATORS)
+
+(* Agreement: two decisions — at any rounds — are for the same value.     *)
+(* The quorum-intersection argument: two 2/3 quorums share an honest      *)
+(* validator, whose lock forces later prevotes.                           *)
+Agreement ==
+  FaultAssumption =>
+    \A r1, r2 \in ROUNDS, v1, v2 \in VALUES :
+      (Decided(r1, v1) /\ Decided(r2, v2)) => v1 = v2
+
+(* No honest equivocation: an honest validator casts at most one prevote  *)
+(* and one precommit per round (vote_set.py ConflictingVoteError guards   *)
+(* this at the wire; here it is structural — votes are never overwritten  *)
+(* for honest v).                                                          *)
+HonestNoEquivocation ==
+  \A v \in Honest, r \in ROUNDS :
+    /\ prevotes[r][v] # NoVote => prevotes'[r][v] = prevotes[r][v]
+    /\ precommits[r][v] # NoVote => precommits'[r][v] = precommits[r][v]
+
+=============================================================================

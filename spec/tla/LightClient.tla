@@ -1,0 +1,135 @@
+--------------------------- MODULE LightClient ---------------------------
+(***************************************************************************)
+(* Light-client skipping verification (bisection), as implemented by       *)
+(* tendermint_tpu/light/client.py and verifier.py.                         *)
+(*                                                                         *)
+(* Reference counterpart: spec/light-client/verification/                  *)
+(* Lightclient_003_draft.tla in the reference repo (re-specified from the  *)
+(* implementation here, not copied).  The property of interest is the      *)
+(* core soundness argument: if every header the client stores was either   *)
+(* (a) the trusted root or (b) accepted by ValidAndVerified against an     *)
+(* already-stored header inside the trusting period, then — under the      *)
+(* failure model that less than 1/3 of any validator set the client        *)
+(* trusts is faulty — every stored header is a header the main chain      *)
+(* actually produced.                                                      *)
+(*                                                                         *)
+(* Status: syntax-complete TLA+, NOT model-checked in this build           *)
+(* environment (no TLC/Apalache in the image — see spec/tla/README.md).    *)
+(***************************************************************************)
+
+EXTENDS Integers, FiniteSets
+
+CONSTANTS
+  HEIGHTS,        \* set of chain heights, e.g. 1..Hmax
+  VALIDATORS,     \* universe of validator identities
+  FAULTY,         \* subset of VALIDATORS that may equivocate/forge
+  TRUSTING_PERIOD,\* duration (abstract time units)
+  TARGET          \* the height the client wants
+
+ASSUME TARGET \in HEIGHTS
+
+(* The real chain: one header per height; abstracted as the validator    *)
+(* sets and times the honest chain committed.                            *)
+CONSTANTS ChainVals, ChainNextVals, ChainTime
+ASSUME ChainVals \in [HEIGHTS -> SUBSET VALIDATORS]
+ASSUME ChainNextVals \in [HEIGHTS -> SUBSET VALIDATORS]
+ASSUME ChainTime \in [HEIGHTS -> Nat]
+
+VARIABLES
+  now,            \* wall-clock time at the client
+  trustedStore,   \* set of heights the client has accepted
+  state           \* "working" | "finishedSuccess" | "finishedFail"
+
+vars == <<now, trustedStore, state>>
+
+(***************************************************************************)
+(* Header/commit abstraction.  A commit for height h carries signatures    *)
+(* from a set of validators; honest validators only sign the real chain's  *)
+(* header at h, so a forged header's signers are a subset of FAULTY.       *)
+(***************************************************************************)
+
+\* voting power abstracted to cardinality (the implementation sums powers;
+\* types/validator_set.py:253-)
+TwoThirds(S, Of) == 3 * Cardinality(S) > 2 * Cardinality(Of)
+OneThird(S, Of)  == 3 * Cardinality(S) >= Cardinality(Of)
+
+InTrustingPeriod(h) == now < ChainTime[h] + TRUSTING_PERIOD
+
+(* verify_adjacent (light/verifier.py): sequential step h -> h+1 checks   *)
+(* next_validators_hash continuity + 2/3 of the NEW header's own set.     *)
+AdjacentOK(th, nh) ==
+  /\ nh = th + 1
+  /\ InTrustingPeriod(th)
+  /\ \E signers \in SUBSET (ChainVals[nh] \union FAULTY) :
+        TwoThirds(signers \intersect ChainVals[nh], ChainVals[nh])
+
+(* verify_non_adjacent (skipping): 1/3 of the TRUSTED set must have      *)
+(* signed the new header (the trust intersection), plus 2/3 of the new   *)
+(* header's own set (light/verifier.py; reference verifier.go:58).       *)
+NonAdjacentOK(th, nh) ==
+  /\ nh > th + 1
+  /\ InTrustingPeriod(th)
+  /\ \E signers \in SUBSET (ChainVals[nh] \union FAULTY) :
+        /\ OneThird(signers \intersect ChainNextVals[th], ChainNextVals[th])
+        /\ TwoThirds(signers \intersect ChainVals[nh], ChainVals[nh])
+
+(***************************************************************************)
+(* Transitions                                                             *)
+(***************************************************************************)
+
+Init ==
+  /\ now \in Nat
+  /\ trustedStore = {CHOOSE h \in HEIGHTS : TRUE}  \* the subjective root
+  /\ state = "working"
+
+VerifyStep ==
+  /\ state = "working"
+  /\ \E th \in trustedStore, nh \in HEIGHTS :
+       /\ nh \notin trustedStore
+       /\ AdjacentOK(th, nh) \/ NonAdjacentOK(th, nh)
+       /\ trustedStore' = trustedStore \union {nh}
+  /\ UNCHANGED <<now, state>>
+
+AdvanceTime ==
+  /\ now' \in {t \in Nat : t > now}
+  /\ UNCHANGED <<trustedStore, state>>
+
+Finish ==
+  /\ state = "working"
+  /\ \/ /\ TARGET \in trustedStore
+        /\ state' = "finishedSuccess"
+     \/ /\ \A th \in trustedStore : ~InTrustingPeriod(th)
+        /\ state' = "finishedFail"
+  /\ UNCHANGED <<now, trustedStore>>
+
+Next == VerifyStep \/ AdvanceTime \/ Finish
+
+Spec == Init /\ [][Next]_vars
+
+(***************************************************************************)
+(* Properties                                                              *)
+(***************************************************************************)
+
+(* Failure model: in any set the client relies on, faulty validators are  *)
+(* less than 1/3 (the standard Tendermint assumption within the trusting  *)
+(* period).                                                                *)
+FaultAssumption ==
+  \A h \in HEIGHTS :
+    3 * Cardinality(FAULTY \intersect ChainVals[h])
+      < Cardinality(ChainVals[h])
+
+(* Soundness: a forged header (one whose honest signers are empty) can    *)
+(* only be accepted if FAULTY alone musters the required thresholds —     *)
+(* excluded by FaultAssumption.  Stated as: every stored height's         *)
+(* accepting signer set contained at least one honest validator of the    *)
+(* real chain's set for that height.                                      *)
+StoreSound ==
+  FaultAssumption =>
+    \A h \in trustedStore :
+      \E v \in ChainVals[h] \ FAULTY : TRUE
+
+(* Termination-shape liveness (checked under fairness of VerifyStep):     *)
+(* the client either reaches TARGET or runs out of trusting period.       *)
+EventuallyDone == <>(state # "working")
+
+=============================================================================

@@ -87,12 +87,31 @@ def _verify_cached_small(tables, tvalid, idx, rb, sb, kb, s_ok):
     return ed25519_batch.verify_prehashed_table(t, tv, rb, sb, kb, s_ok)
 
 
+def _use_mxu_gather() -> bool:
+    """TM_TPU_MXU_GATHER=1 swaps the big tier's per-window gathers for
+    one-hot MXU matmuls (ops/curve25519.scalar_mult_var_bigcache_mxu) —
+    faster where the MXU is real silicon, slower on this harness's
+    executor. Read ONCE at BatchVerifier construction: the selection must
+    not depend on when each shape bucket happens to trace."""
+    import os
+
+    return os.environ.get("TM_TPU_MXU_GATHER") == "1"
+
+
 def _verify_cached_big(tables, tvalid, idx, rb, sb, kb, s_ok):
     """Big tier: doubling-free fixed-window verify against the shared
     cache (the kernel gathers per-window slices internally so the 512 KiB
     per-key tables are never materialized per batch row)."""
     tv = jnp.take(tvalid, jnp.maximum(idx, 0), axis=0) & (idx >= 0)
     return ed25519_batch.verify_prehashed_bigcache(
+        tables, tv, jnp.maximum(idx, 0), rb, sb, kb, s_ok
+    )
+
+
+def _verify_cached_big_mxu(tables, tvalid, idx, rb, sb, kb, s_ok):
+    """_verify_cached_big with the MXU one-hot gather (see _use_mxu_gather)."""
+    tv = jnp.take(tvalid, jnp.maximum(idx, 0), axis=0) & (idx >= 0)
+    return ed25519_batch.verify_prehashed_bigcache_mxu(
         tables, tv, jnp.maximum(idx, 0), rb, sb, kb, s_ok
     )
 
@@ -232,11 +251,14 @@ class BatchVerifier:
         self._min_device_batch = min_device_batch
         self._device_challenge_min = device_challenge_min
         self._bigtable_min = bigtable_min
+        big_impl = (
+            _verify_cached_big_mxu if _use_mxu_gather() else _verify_cached_big
+        )
         if mesh is None:
             jit = jax.jit
             self._fn = jit(ed25519_batch.verify_prehashed)
             self._small_fn = jit(_verify_cached_small)
-            self._big_fn = jit(_verify_cached_big)
+            self._big_fn = jit(big_impl)
             self._msgs_fn = jit(_verify_cached_msgs)
             build_small = jit(ed25519_batch.neg_pubkey_table)
             build_big = jit(ed25519_batch.neg_pubkey_bigtable)
@@ -256,7 +278,7 @@ class BatchVerifier:
                 out_shardings=rep,
             )
             self._big_fn = jax.jit(
-                _verify_cached_big,
+                big_impl,
                 in_shardings=(rep, rep, sh, sh, sh, sh, sh),
                 out_shardings=rep,
             )

@@ -375,6 +375,51 @@ def scalar_mult_var_bigcache(
     return jax.lax.fori_loop(0, 64, body, identity(digs.shape[:-1]))
 
 
+def scalar_mult_var_bigcache_mxu(
+    scalar_bytes: jnp.ndarray,  # [B, 32] u8
+    tables_cache: jnp.ndarray,  # [cap, 64, 16, 4, 32] fixed-window tables
+    idx: jnp.ndarray,  # [B] int32 row index into the cache
+) -> jnp.ndarray:
+    """scalar_mult_var_bigcache with the per-window gather recast as a
+    ONE-HOT MATMUL — the MXU-native formulation of a table lookup.
+
+    Per window w, the selected entry is
+        onehot[b, idx[b]*16 + digs[b,w]] @ tables[:, w].reshape(cap*16, 128)
+    i.e. a [B, cap*16] x [cap*16, 128] f32 matmul whose left operand has
+    one 1 per row. Exactness: table limbs satisfy the loose invariant
+    limbs in [0, 2^9) (field25519.py — device-built tables come out of
+    fe.mul un-canonicalized), and any value < 2^24 is exact in f32; a
+    narrower dtype (bf16/int8) would NOT be safe without canonicalizing
+    the tables first.
+    On MXU silicon this turns the generalized gather — the measured
+    bottleneck of the fori_loop path — into systolic-array work the chip
+    is built for; on this harness's executor (~0.1 TFLOP/s effective) the
+    extra FLOPs dominate instead, so BatchVerifier selects it only when
+    TM_TPU_MXU_GATHER=1. Verified bit-identical to the gather path in
+    tests/test_ops_curve25519.py.
+    """
+    digs = nibbles(scalar_bytes)  # [B, 64] LSB-first
+    cap = tables_cache.shape[0]
+    flat = tables_cache.astype(jnp.float32).reshape(cap, 64, 16, 128)
+
+    def body(i, acc):
+        tab_w = jax.lax.dynamic_index_in_dim(
+            flat, i, axis=1, keepdims=False
+        ).reshape(cap * 16, 128)
+        sel = idx * 16 + digs[..., i]  # [B] combined row index
+        onehot = (
+            sel[:, None] == jnp.arange(cap * 16, dtype=jnp.int32)[None, :]
+        ).astype(jnp.float32)
+        ent = (
+            jnp.dot(onehot, tab_w, precision=jax.lax.Precision.HIGHEST)
+            .astype(jnp.int32)
+            .reshape(-1, 4, 32)
+        )
+        return add_cached(acc, ent)
+
+    return jax.lax.fori_loop(0, 64, body, identity(digs.shape[:-1]))
+
+
 def scalar_mult_var_table(
     scalar_bytes: jnp.ndarray, table: jnp.ndarray
 ) -> jnp.ndarray:

@@ -105,6 +105,27 @@ def verify_prehashed_bigcache(
     return table_valid & s_ok & r_match
 
 
+def verify_prehashed_bigcache_mxu(
+    tables_cache: jnp.ndarray,
+    table_valid: jnp.ndarray,
+    idx: jnp.ndarray,
+    r_bytes: jnp.ndarray,
+    s_bytes: jnp.ndarray,
+    k_bytes: jnp.ndarray,
+    s_ok: jnp.ndarray,
+) -> jnp.ndarray:
+    """verify_prehashed_bigcache with the table lookups as one-hot MXU
+    matmuls (curve.scalar_mult_var_bigcache_mxu) — the real-silicon
+    variant; select via TM_TPU_MXU_GATHER=1 (see the kernel docstring)."""
+    q = curve.add(
+        curve.scalar_mult_base(s_bytes),
+        curve.scalar_mult_var_bigcache_mxu(k_bytes, tables_cache, idx),
+    )
+    encoded = curve.compress(q)
+    r_match = jnp.all(encoded == r_bytes, axis=-1)
+    return table_valid & s_ok & r_match
+
+
 def verify_msgs_bigcache(
     tables_cache: jnp.ndarray,  # [cap, 64, 16, 4, 32] shared table cache
     table_valid: jnp.ndarray,  # [B] bool

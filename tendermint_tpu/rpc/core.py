@@ -5,6 +5,13 @@ broadcast routes are deleted along with the mempool) + rpc/core/*.go
 handlers reading the node environment (node/node.go:1174-1200). Bytes are
 hex-encoded in results (the reference mixes hex and base64; hex
 throughout keeps the surface predictable).
+
+No gRPC API route: the fork's rpc/grpc surface is Ping-only after the
+mempool removal (rpc/grpc/api.go:10-13 — BroadcastTx went with the
+mempool), and `health` over JSON-RPC/websocket is this framework's
+equivalent liveness probe. The ABCI process boundary (the load-bearing
+RPC in the reference) is covered by abci/client.py's socket protocol +
+abci-cli.
 """
 
 from __future__ import annotations

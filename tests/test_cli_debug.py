@@ -174,3 +174,30 @@ def test_abci_cli_against_kvstore_server():
     finally:
         srv.kill()
         srv.wait(timeout=10)
+
+
+def test_loadtime_run_and_report():
+    """tools/loadtime parity (reference test/loadtime + runner/benchmark.go):
+    burst load through the L2 feed, then a latency/interval report read
+    back from the block store."""
+    import asyncio
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "loadtime",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "loadtime.py"),
+    )
+    lt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lt)
+
+    rep = asyncio.run(lt.run_load(blocks=4, rate=10))
+    assert rep["blocks"] >= 4
+    assert rep["txs"] >= 30
+    assert rep["tx_per_s"] > 0
+    assert rep["tx_latency_ms"]["avg"] > 0
+    assert rep["block_interval_s"]["max"] >= rep["block_interval_s"]["min"]
+    # tx round-trip helpers
+    tx = lt.make_tx(7)
+    assert lt.parse_tx_time(tx) is not None
+    assert lt.parse_tx_time(b"garbage") is None

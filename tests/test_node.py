@@ -349,3 +349,49 @@ def test_node_commits_batch_point_with_bls(tmp_path):
     assert bls.verify_aggregated_same_message(
         agg, batch_hash, [pub] * len(sigs)
     )
+
+
+def test_node_upgrade_switch_to_sequencer(tmp_path):
+    """The assembled Node's upgrade path (reference node.go + upgrade/):
+    BFT commits up to switch_height, consensus stops, and StateV2 takes
+    over producing BlockV2s through the same L2 node."""
+    from tendermint_tpu.crypto import secp256k1
+
+    cfg = make_test_config(tmp_path, switch_height=3)
+    # sequencer identity: a local secp key this node signs V2 blocks with
+    key = secp256k1.PrivKey.from_secret(b"seq-node-test")
+    os.makedirs(str(tmp_path / "config"), exist_ok=True)
+    with open(str(tmp_path / "config" / "sequencer_key"), "w") as f:
+        f.write(key.bytes().hex())
+    cfg.sequencer.sequencer_key_file = "config/sequencer_key"
+    cfg.sequencer.block_interval = 0.1
+    init_files(cfg)
+    node = Node(cfg)
+
+    async def run():
+        await node.start()
+        try:
+            await node.consensus.wait_for_height(3, timeout=60)
+            # the switch fires on the commit of switch_height; wait for
+            # sequencer mode + at least 2 produced V2 blocks
+            for _ in range(200):
+                if (
+                    node.sequencer_reactor.sequencer_started
+                    and node.state_v2.latest_height()
+                    >= node.consensus.state.last_block_height + 2
+                ):
+                    break
+                await asyncio.sleep(0.1)
+            assert node.sequencer_reactor.sequencer_started, (
+                "sequencer routines never started after switch_height"
+            )
+            assert not node.consensus.is_running
+            assert node.state_v2.is_sequencer_mode()
+            assert (
+                node.state_v2.latest_height()
+                >= node.consensus.state.last_block_height + 2
+            ), "no V2 blocks produced after the switch"
+        finally:
+            await node.stop()
+
+    asyncio.run(run())

@@ -137,3 +137,37 @@ def test_scalar_mult_var_bigtable_matches_host():
     inv = jnp.asarray(np.array([1, 2, 0], dtype=np.int32))
     out2 = jax.jit(curve.scalar_mult_var_bigcache)(sb, cache, inv)
     _assert_points_equal(out2, expected)
+
+
+def test_bigcache_mxu_matches_gather_path():
+    """The one-hot-matmul (MXU) formulation of the fixed-window lookup
+    must be bit-identical to the gather path for valid and invalid rows
+    (it is selected on real silicon via TM_TPU_MXU_GATHER=1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _make_batch
+    from tendermint_tpu.ops.ed25519_batch import (
+        neg_pubkey_bigtable,
+        verify_prehashed_bigcache,
+        verify_prehashed_bigcache_mxu,
+    )
+
+    n = 8
+    pub, rb, sb, kb, s_ok = _make_batch(n)
+    sb[2] ^= 1  # corrupt one row
+    tables, valid = jax.jit(neg_pubkey_bigtable)(jnp.asarray(pub))
+    idx = jnp.arange(n, dtype=jnp.int32)
+    args = (
+        tables,
+        valid,
+        idx,
+        jnp.asarray(rb),
+        jnp.asarray(sb),
+        jnp.asarray(kb),
+        jnp.asarray(s_ok),
+    )
+    out_g = np.asarray(jax.jit(verify_prehashed_bigcache)(*args))
+    out_m = np.asarray(jax.jit(verify_prehashed_bigcache_mxu)(*args))
+    assert (out_g == out_m).all()
+    assert out_g[0] and not out_g[2]

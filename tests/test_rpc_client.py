@@ -130,3 +130,31 @@ def test_light_proxy_serves_verified_data(tmp_path):
         await node.stop()
 
     asyncio.run(run())
+
+
+def test_openapi_doc_matches_route_table():
+    """rpc/openapi.yaml (reference rpc/openapi/openapi.yaml role) must
+    list exactly the live route table — doc drift fails here."""
+    import os
+    import re
+
+    from tendermint_tpu.rpc.core import RPCCore
+
+    class _N:
+        class config:
+            class rpc:
+                unsafe = True
+
+    live = set(RPCCore(_N()).routes())
+    path = os.path.join(
+        os.path.dirname(__file__),
+        "..",
+        "tendermint_tpu",
+        "rpc",
+        "openapi.yaml",
+    )
+    doc = set(re.findall(r"^\s+- ([a-z_]+)\s+#", open(path).read(), re.M))
+    assert live == doc, (
+        f"openapi drift: missing={sorted(live - doc)} "
+        f"stale={sorted(doc - live)}"
+    )

@@ -1,0 +1,156 @@
+"""loadtime — tx load generation + latency report from the block store.
+
+Reference: test/loadtime/ (tm-load-test based `load` + `report` reading
+the blockstore, test/loadtime/README.md) and test/e2e/runner/benchmark.go
+:13-76 (block-interval stats over an N-block window).
+
+Same measurement design as the reference: each generated tx embeds its
+send-time; the report walks committed blocks and computes per-tx latency
+as (block time - embedded send time), plus block-interval min/avg/stddev/
+max. The morph fork has no mempool — load enters through the L2 node's
+block-data feed (l2node inject), which is where production txs come from
+too (SURVEY.md §3.2).
+
+Usage:
+    python tools/loadtime.py run     # in-proc node, burst load, report
+    python tools/loadtime.py report --home <dir>   # report over a store
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import math
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TX_PREFIX = b"loadtime:"
+
+
+def make_tx(seq: int, size: int = 128) -> bytes:
+    """Payload embeds the send timestamp, as the reference's loadtime
+    payload proto does (test/loadtime/payload/)."""
+    head = TX_PREFIX + str(time.time_ns()).encode() + b":" + str(seq).encode()
+    return head + b":" + b"x" * max(0, size - len(head) - 1)
+
+
+def parse_tx_time(tx: bytes) -> int | None:
+    if not tx.startswith(TX_PREFIX):
+        return None
+    try:
+        return int(tx.split(b":", 3)[1])
+    except (IndexError, ValueError):
+        return None
+
+
+def report_from_store(block_store, first: int = 1, last: int = 0) -> dict:
+    """Latency + block-interval stats (benchmark.go:22-76 shape)."""
+    last = last or block_store.height
+    latencies_ms: list[float] = []
+    intervals_s: list[float] = []
+    n_txs = 0
+    prev_time = None
+    for h in range(max(first, block_store.base), last + 1):
+        block = block_store.load_block(h)
+        if block is None:
+            continue
+        bt = block.header.time_ns
+        if prev_time is not None:
+            intervals_s.append((bt - prev_time) / 1e9)
+        prev_time = bt
+        for tx in block.data.txs:
+            n_txs += 1
+            sent = parse_tx_time(tx)
+            if sent is not None:
+                latencies_ms.append((bt - sent) / 1e6)
+
+    def stats(xs):
+        if not xs:
+            return {"min": 0, "avg": 0, "stddev": 0, "max": 0}
+        return {
+            "min": round(min(xs), 2),
+            "avg": round(statistics.fmean(xs), 2),
+            "stddev": round(statistics.pstdev(xs), 2) if len(xs) > 1 else 0,
+            "max": round(max(xs), 2),
+        }
+
+    dur_s = (
+        sum(intervals_s) if intervals_s else 0.0
+    )
+    return {
+        "blocks": len(intervals_s) + 1 if prev_time is not None else 0,
+        "txs": n_txs,
+        "tx_per_s": round(n_txs / dur_s, 1) if dur_s else 0.0,
+        "block_interval_s": stats(intervals_s),
+        "tx_latency_ms": stats(latencies_ms),
+    }
+
+
+async def run_load(
+    blocks: int = 10, rate: int = 50, tx_size: int = 128
+) -> dict:
+    """In-proc single-validator node under tx load; returns the report."""
+    import tempfile
+
+    from tendermint_tpu.config import Config
+    from tendermint_tpu.l2node.mock import MockL2Node
+    from tendermint_tpu.node import Node, init_files
+
+    with tempfile.TemporaryDirectory() as home:
+        cfg = Config.test_config()
+        cfg.root_dir = home
+        cfg.base.db_backend = "memory"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        init_files(cfg)
+        l2 = MockL2Node(txs_per_block=0)
+        node = Node(cfg, l2_node=l2)
+        await node.start()
+        seq = 0
+        try:
+            target = node.consensus.state.last_block_height + blocks
+            while node.consensus.state.last_block_height < target:
+                burst = [make_tx(seq + i, tx_size) for i in range(rate)]
+                seq += rate
+                l2.inject_txs(burst)
+                await asyncio.sleep(0.1)
+            return report_from_store(node.block_store)
+        finally:
+            await node.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("run", help="in-proc node + burst load + report")
+    rp.add_argument("--blocks", type=int, default=10)
+    rp.add_argument("--rate", type=int, default=50)
+    rp.add_argument("--size", type=int, default=128)
+    gp = sub.add_parser("report", help="report over an existing home dir")
+    gp.add_argument("--home", required=True)
+    args = ap.parse_args()
+
+    import json
+
+    if args.cmd == "run":
+        rep = asyncio.run(
+            run_load(blocks=args.blocks, rate=args.rate, tx_size=args.size)
+        )
+    else:
+        from tendermint_tpu.store.block_store import BlockStore
+        from tendermint_tpu.store.kv import SqliteKV
+
+        bs = BlockStore(
+            SqliteKV(os.path.join(args.home, "data", "blockstore.db"))
+        )
+        rep = report_from_store(bs)
+    print(json.dumps(rep, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
