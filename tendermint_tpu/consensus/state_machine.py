@@ -199,7 +199,13 @@ class ConsensusState:
 
     # --- lifecycle --------------------------------------------------------
 
-    async def start(self) -> None:
+    async def start(self, skip_wal_catchup: bool = False) -> None:
+        """skip_wal_catchup: set when entering from blocksync/statesync —
+        those paths advance state PAST the WAL's last end-height barrier,
+        so the in-flight-message replay is both impossible and unneeded
+        (the reference's SwitchToConsensus(state, skipWAL=true),
+        consensus/state.go). An end-height record for the synced height is
+        written instead so the next plain restart replays cleanly."""
         if self.priv_validator is not None:
             pk = self.priv_validator.get_pub_key()
             if asyncio.iscoroutine(pk):
@@ -208,7 +214,10 @@ class ConsensusState:
         self._update_to_state(self.state)
         # crash recovery: re-feed in-flight WAL messages before going live
         # (reference catchupReplay, consensus/replay.go:95-173)
-        if not isinstance(self.wal, NilWAL):
+        if skip_wal_catchup:
+            if not isinstance(self.wal, NilWAL):
+                self.wal.write_end_height(self.state.last_block_height)
+        elif not isinstance(self.wal, NilWAL):
             from .replay import catchup_replay
 
             n = await catchup_replay(self, self.wal)
@@ -855,6 +864,13 @@ class ConsensusState:
 
         # batch cache rollover (reference state.go:1902-1910)
         self.batch_cache.on_block_committed(block)
+        self.logger.info(
+            "committed block",
+            height=height,
+            round=self.rs.round,
+            txs=len(block.data.txs),
+            batch_point=bool(block.header.batch_hash),
+        )
 
         # upgrade switch (reference state.go:1921-1938 + upgrade/upgrade.go)
         if self.upgrade_height and height >= self.upgrade_height:

@@ -532,8 +532,14 @@ def default_verifier() -> BatchVerifier:
         import os
 
         dcm = int(os.environ.get("TM_TPU_DEVICE_CHALLENGE_MIN", "0") or 0)
+        # TM_TPU_MIN_DEVICE_BATCH raises the host/device crossover — set
+        # it very large to force pure-host verification (CPU-only
+        # deployments and subprocess tests where a JAX compile would
+        # dominate the workload)
+        mdb = int(os.environ.get("TM_TPU_MIN_DEVICE_BATCH", "8") or 8)
         _default = BatchVerifier(
-            device_challenge_min=dcm if dcm > 0 else None
+            min_device_batch=mdb,
+            device_challenge_min=dcm if dcm > 0 else None,
         )
     return _default
 

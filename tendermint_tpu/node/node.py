@@ -323,7 +323,22 @@ class Node(Service):
             height=state.last_block_height,
         )
         self.consensus.state = state
-        await self.consensus.start()
+        try:
+            # skip WAL catchup ONLY when blocksync actually advanced state
+            # past the WAL's last end-height barrier (reference
+            # SwitchToConsensus(state, blocksSynced > 0)); a restart that
+            # synced nothing must still replay in-flight WAL messages —
+            # that replay restores the POL lock that prevents double-signs
+            synced = self.blocksync_reactor.blocks_applied > 0
+            await self.consensus.start(skip_wal_catchup=synced)
+        except Exception as e:
+            # the switch-over runs inside blocksync's pool task — an
+            # exception here must not die silently (that failure mode
+            # presented as a live-looking node that never participates)
+            self.logger.error(
+                "consensus start failed after blocksync", err=repr(e)
+            )
+            raise
 
     # --- lifecycle (node.go:1041-1112) ---------------------------------------
 
@@ -465,7 +480,9 @@ class Node(Service):
         self.state_store.bootstrap(state)
         self.block_store.save_seen_commit(state.last_block_height, commit)
         self.consensus.state = state
-        await self.consensus.start()
+        # statesync jumped state far past any WAL content (same skipWAL
+        # rationale as the blocksync switch-over)
+        await self.consensus.start(skip_wal_catchup=True)
 
     async def on_stop(self) -> None:
         if self.consensus.is_running:

@@ -80,13 +80,28 @@ def _wait_heights(ports, target: int, deadline_s: float) -> None:
 
 
 def _spawn(home: str):
-    return subprocess.Popen(
-        [sys.executable, "-m", "tendermint_tpu", "--home", home, "start"],
-        cwd=REPO,
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-        start_new_session=True,  # survives pytest's signal handling
-    )
+    env = dict(os.environ)
+    # the spawned nodes verify 4-validator batches (host fast path); the
+    # CPU backend keeps them off the single tunnelled TPU chip — four
+    # processes warming big-tier tables through one tunnel at startup is
+    # the measured flake source for the stage deadlines
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TM_TPU_SKIP_WARM"] = "1"
+    # pure-host verification: a 4-validator net's batches never earn a
+    # JAX compile, and a blocksync window must not trigger one either
+    env["TM_TPU_MIN_DEVICE_BATCH"] = str(1 << 30)
+    log = open(os.path.join(home, "node.log"), "ab")
+    try:
+        return subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu", "--home", home, "start"],
+            cwd=REPO,
+            env=env,
+            stdout=log,
+            stderr=log,
+            start_new_session=True,  # survives pytest's signal handling
+        )
+    finally:
+        log.close()  # the child holds its own inherited descriptor
 
 
 def test_multiprocess_testnet_kill9_restart(tmp_path):
@@ -162,6 +177,32 @@ def test_multiprocess_testnet_kill9_restart(tmp_path):
             for p in rpc_ports
         }
         assert len(hashes) == 1, f"nodes diverged at height {h}"
+
+        # a FRESH non-validator full node (key not in genesis, empty
+        # store) joins and blocksyncs the whole chain from the live net —
+        # the observer-node role (reference e2e "full" node mode)
+        import shutil
+
+        from tendermint_tpu.config import Config as _C
+
+        full_home = os.path.join(base, "fullnode")
+        fcfg = _C()
+        fcfg.root_dir = full_home
+        fcfg.ensure_dirs()
+        shutil.copy(
+            os.path.join(homes[0], "config", "genesis.json"),
+            os.path.join(full_home, "config", "genesis.json"),
+        )
+        fp2p, frpc = _free_ports(2)
+        fcfg.p2p.laddr = f"tcp://127.0.0.1:{fp2p}"
+        fcfg.rpc.laddr = f"tcp://127.0.0.1:{frpc}"
+        fcfg.p2p.persistent_peers = peers
+        fcfg.save()
+        procs["full"] = _spawn(full_home)
+        target = max(_height(p) for p in rpc_ports)
+        _wait_heights([frpc], target, deadline_s=150)
+        hf = _rpc(frpc, "block", height=h)["block_id"]["hash"]
+        assert hf in hashes, "full node synced a different chain"
     finally:
         for p in procs.values():
             if p.poll() is None:

@@ -162,3 +162,49 @@ def test_handshake_replays_into_fresh_app(tmp_path):
     assert replayed >= 3
     assert fresh_h >= 3
     assert state.last_block_height == fresh_h
+
+
+def test_blocksync_switchover_skips_wal_catchup(tmp_path):
+    """Regression (mp e2e stall): blocksync advances state PAST the WAL's
+    last end-height barrier; consensus.start() must refuse to replay (the
+    lock state is unrecoverable) but start(skip_wal_catchup=True) — the
+    reference's SwitchToConsensus skipWAL path — must start cleanly and
+    re-anchor the WAL so the NEXT plain restart replays fine."""
+    from tests.helpers import make_genesis, make_validators
+    from tests.test_consensus import make_node
+
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+
+    async def run():
+        wal_path = str(tmp_path / "cs.wal")
+        wal = WAL(wal_path)
+        # WAL saw heights up to 2...
+        wal.write_end_height(1)
+        wal.write_end_height(2)
+        wal.flush_and_sync()
+
+        cs, app, l2, bs, ss = make_node(vs, pvs[0], genesis)
+        cs.wal = wal
+        # ...but (simulated) blocksync moved state to 5
+        cs.state.last_block_height = 5
+        with pytest.raises(RuntimeError):
+            await cs.start()
+        await cs.stop()
+
+        cs2, app, l2, bs, ss = make_node(vs, pvs[0], genesis)
+        cs2.wal = WAL(wal_path)
+        cs2.state.last_block_height = 5
+        await cs2.start(skip_wal_catchup=True)
+        assert cs2.rs.height == 6
+        await cs2.stop()
+
+        # the skip wrote an end-height barrier: a plain restart replays
+        cs3, app, l2, bs, ss = make_node(vs, pvs[0], genesis)
+        cs3.wal = WAL(wal_path)
+        cs3.state.last_block_height = 5
+        await cs3.start()  # must NOT raise now
+        assert cs3.rs.height == 6
+        await cs3.stop()
+
+    asyncio.run(run())
