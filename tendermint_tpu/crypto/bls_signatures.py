@@ -257,16 +257,21 @@ DEVICE_AGGREGATE_MIN = 64
 
 
 def aggregate_signatures(sigs: list):
-    if len(sigs) >= DEVICE_AGGREGATE_MIN:
-        try:
-            return aggregate_signatures_device(sigs)
-        except Exception:  # no usable backend: the host paths are exact
-            pass
+    """Point sum of N G1 signatures. Preference order (r3, measured):
+    native C++ MSM (~2 us/add, no warm-up), then the device tree
+    reduction (ops/bls_g1 — the mesh-scale path; pays a one-time compile,
+    so it only leads where the native library is unavailable or the
+    deployment pins aggregation on-device), then the exact host loop."""
     if native.native_lib() is not None and len(sigs) > 1:
         out = native.g1_msm(
             b"".join(g1_to_bytes(s) for s in sigs), None, len(sigs)
         )
         return _g1_parse_unchecked(out)
+    if len(sigs) >= DEVICE_AGGREGATE_MIN:
+        try:
+            return aggregate_signatures_device(sigs)
+        except Exception:  # no usable backend: the host paths are exact
+            pass
     acc = c.G1_INF
     for s in sigs:
         acc = c.g1_add(acc, s)
