@@ -47,6 +47,11 @@ class LightClientError(Exception):
     pass
 
 
+class ErrNoProviderBlock(LightClientError):
+    """No provider (primary or witness) has the requested height — often
+    a height the chain simply hasn't produced yet; retryable."""
+
+
 class ErrNoWitnesses(LightClientError):
     pass
 
@@ -428,4 +433,6 @@ class LightClient:
                 self.witnesses[i] = old_primary
                 lb.validate_basic(self.chain_id)
                 return lb
-        raise LightClientError(f"no provider has block at height {height}")
+        raise ErrNoProviderBlock(
+            f"no provider has block at height {height}"
+        )

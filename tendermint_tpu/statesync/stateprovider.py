@@ -10,7 +10,9 @@ from __future__ import annotations
 
 from typing import Optional, Protocol, runtime_checkable
 
-from ..light.client import LightClient
+import asyncio
+
+from ..light.client import ErrNoProviderBlock, LightClient
 from ..state.state import State
 from ..types.block import Commit
 from ..types.params import ConsensusParams
@@ -39,22 +41,38 @@ class LightClientStateProvider:
         # RPC-backed provider)
         self._params = consensus_params or ConsensusParams()
 
+    async def _verify_retry(self, height: int):
+        """verify_light_block_at_height with a bounded wait for heights
+        the chain hasn't produced YET: verifying the freshest snapshot at
+        H needs headers H+1 and H+2, which land one block interval later.
+        The reference's light provider blocks until the primary has the
+        height (light/provider/http retry loop); here: up to ~20 s.
+        Genuine verification failures re-raise immediately."""
+        delay = 0.5
+        for _ in range(10):
+            try:
+                return await self._lc.verify_light_block_at_height(height)
+            except ErrNoProviderBlock:
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 4.0)
+        return await self._lc.verify_light_block_at_height(height)
+
     async def app_hash(self, height: int) -> bytes:
         """App hash FOR height lives in the header at height+1 (:100-120).
         Also pre-verifies height+2, needed by state() later."""
-        header = await self._lc.verify_light_block_at_height(height + 1)
-        await self._lc.verify_light_block_at_height(height + 2)
+        header = await self._verify_retry(height + 1)
+        await self._verify_retry(height + 2)
         return header.header.app_hash
 
     async def commit(self, height: int) -> Commit:
-        lb = await self._lc.verify_light_block_at_height(height)
+        lb = await self._verify_retry(height)
         return lb.commit
 
     async def state(self, height: int) -> State:
         """Assemble State for resuming after the snapshot (:135-205)."""
-        last = await self._lc.verify_light_block_at_height(height)
-        current = await self._lc.verify_light_block_at_height(height + 1)
-        nxt = await self._lc.verify_light_block_at_height(height + 2)
+        last = await self._verify_retry(height)
+        current = await self._verify_retry(height + 1)
+        nxt = await self._verify_retry(height + 2)
         return State(
             chain_id=self._lc.chain_id,
             initial_height=self._initial_height,

@@ -178,31 +178,64 @@ def test_multiprocess_testnet_kill9_restart(tmp_path):
         }
         assert len(hashes) == 1, f"nodes diverged at height {h}"
 
-        # a FRESH non-validator full node (key not in genesis, empty
-        # store) joins and blocksyncs the whole chain from the live net —
-        # the observer-node role (reference e2e "full" node mode)
-        import shutil
+        def spawn_observer(name, configure=None):
+            """Boot a fresh NON-validator node home (key not in genesis,
+            empty store) joined to the live net; returns its rpc port."""
+            import shutil
 
-        from tendermint_tpu.config import Config as _C
+            from tendermint_tpu.config import Config as _C
 
-        full_home = os.path.join(base, "fullnode")
-        fcfg = _C()
-        fcfg.root_dir = full_home
-        fcfg.ensure_dirs()
-        shutil.copy(
-            os.path.join(homes[0], "config", "genesis.json"),
-            os.path.join(full_home, "config", "genesis.json"),
-        )
-        fp2p, frpc = _free_ports(2)
-        fcfg.p2p.laddr = f"tcp://127.0.0.1:{fp2p}"
-        fcfg.rpc.laddr = f"tcp://127.0.0.1:{frpc}"
-        fcfg.p2p.persistent_peers = peers
-        fcfg.save()
-        procs["full"] = _spawn(full_home)
+            home = os.path.join(base, name)
+            cfg = _C()
+            cfg.root_dir = home
+            cfg.ensure_dirs()
+            shutil.copy(
+                os.path.join(homes[0], "config", "genesis.json"),
+                os.path.join(home, "config", "genesis.json"),
+            )
+            op2p, orpc = _free_ports(2)
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{op2p}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{orpc}"
+            cfg.p2p.persistent_peers = peers
+            if configure is not None:
+                configure(cfg)
+            cfg.save()
+            procs[name] = _spawn(home)
+            return orpc
+
+        # a FRESH full node joins and blocksyncs the whole chain from the
+        # live net — the observer role (reference e2e "full" node mode)
+        frpc = spawn_observer("fullnode")
         target = max(_height(p) for p in rpc_ports)
         _wait_heights([frpc], target, deadline_s=150)
         hf = _rpc(frpc, "block", height=h)["block_id"]["hash"]
         assert hf in hashes, "full node synced a different chain"
+
+        # a STATESYNC node bootstraps from a snapshot (light-client trust
+        # root over the survivors' RPC + chunks over p2p) instead of
+        # replaying blocks — reference test/e2e statesync node mode
+        trust_h = max(2, _height(rpc_ports[0]) - 3)
+        commit = _rpc(rpc_ports[0], "commit", height=trust_h)
+        trust_hash = commit["signed_header"]["commit"]["block_id"]["hash"]
+
+        def _cfg_statesync(cfg):
+            cfg.statesync.enable = True
+            cfg.statesync.rpc_servers = (
+                f"127.0.0.1:{rpc_ports[0]},127.0.0.1:{rpc_ports[1]}"
+            )
+            cfg.statesync.trust_height = trust_h
+            cfg.statesync.trust_hash = trust_hash.lower()
+            cfg.statesync.discovery_time = 3.0
+
+        srpc = spawn_observer("statesyncnode", _cfg_statesync)
+        target = max(_height(p) for p in rpc_ports)
+        _wait_heights([srpc], target, deadline_s=180)
+        # proof it STATE-synced: its store has no early blocks
+        try:
+            _rpc(srpc, "block", height=1)
+            assert False, "statesync node has genesis-era blocks"
+        except RuntimeError:
+            pass  # -32000 no block — expected
     finally:
         for p in procs.values():
             if p.poll() is None:
