@@ -132,11 +132,13 @@ def main() -> None:
     # generic path (fresh pubkeys) — informational; the tunnel's remote
     # compile intermittently drops large programs, so failures here must
     # not lose the headline measurement
+    generic_rate = None
     try:
         generic_fn = jax.jit(verify_prehashed)
         dt_generic = _time_best(generic_fn, pub, rb, sb, kb, s_ok)
+        generic_rate = BATCH / dt_generic
         print(
-            f"# generic path: {BATCH / dt_generic:,.0f} sigs/s "
+            f"# generic path: {generic_rate:,.0f} sigs/s "
             f"({dt_generic*1e3:.0f} ms/{BATCH})",
             file=sys.stderr,
         )
@@ -154,7 +156,24 @@ def main() -> None:
                 # the rest of the bench family (VERDICT r2 weak #7: one
                 # recorded metric left regressions in the other paths
                 # invisible); each entry is metric/value/unit/vs_baseline
-                "extra_metrics": _extra_metrics(
+                "extra_metrics": (
+                    [
+                        # fresh-pubkey (validator-churn) path — recorded so
+                        # the driver sees regressions in the uncached edge
+                        # (VERDICT r2 weak #2)
+                        {
+                            "metric": "ed25519_generic_verify_throughput",
+                            "value": round(generic_rate, 1),
+                            "unit": "sigs/s/chip",
+                            "vs_baseline": round(
+                                generic_rate / BASELINE_SERIAL_SIGS_PER_S, 3
+                            ),
+                        }
+                    ]
+                    if generic_rate
+                    else []
+                )
+                + _extra_metrics(
                     cached_fn, tables, valid, idx, rb, sb, kb, s_ok
                 ),
             }

@@ -1317,15 +1317,17 @@ int tmbls_g1_msm(uint8_t *out, const uint8_t *pts, const uint8_t *ks,
                  size_t n) {
     g1 acc = {FP_ONE_MONT, FP_ONE_MONT, FP_ZERO};
     if (ks != nullptr && n >= MSM_MIN) {
-        // nothrow: this ABI reports failure as -1, never as an exception
-        // escaping extern "C" into the FFI caller
+        // nothrow: no exception may escape extern "C" into the FFI
+        // caller; allocation failure is a resource problem, not bad
+        // input, so it falls through to the allocation-free serial loop
         g1 *ps = new (std::nothrow) g1[n];
         uint64_t(*k)[4] = new (std::nothrow) uint64_t[n][4];
         if (ps == nullptr || k == nullptr) {
             delete[] ps;
             delete[] k;
-            return -1;
+            goto g1_serial;
         }
+        {
         size_t m = 0;
         for (size_t i = 0; i < n; i++) {
             g1 p;
@@ -1341,7 +1343,9 @@ int tmbls_g1_msm(uint8_t *out, const uint8_t *pts, const uint8_t *ks,
         delete[] k;
         g1_to_wire(out, acc);
         return 1;
+        }
     }
+g1_serial:
     for (size_t i = 0; i < n; i++) {
         g1 p;
         int rc = g1_from_wire(p, pts + 96 * i);
@@ -1375,8 +1379,9 @@ int tmbls_g2_msm(uint8_t *out, const uint8_t *pts, const uint8_t *ks,
         if (ps == nullptr || k == nullptr) {
             delete[] ps;
             delete[] k;
-            return -1;
+            goto g2_serial;
         }
+        {
         size_t m = 0;
         for (size_t i = 0; i < n; i++) {
             g2 p;
@@ -1392,7 +1397,9 @@ int tmbls_g2_msm(uint8_t *out, const uint8_t *pts, const uint8_t *ks,
         delete[] k;
         g2_to_wire(out, acc);
         return 1;
+        }
     }
+g2_serial:
     for (size_t i = 0; i < n; i++) {
         g2 p;
         int rc = g2_from_wire(p, pts + 192 * i);
