@@ -46,6 +46,16 @@ def _hex(b: bytes) -> str:
     return b.hex().upper()
 
 
+def _seq_started(node) -> bool:
+    return bool(
+        getattr(
+            getattr(node, "sequencer_reactor", None),
+            "sequencer_started",
+            False,
+        )
+    )
+
+
 class RPCCore:
     def __init__(self, node):
         self.node = node
@@ -115,7 +125,20 @@ class RPCCore:
                 "latest_block_hash": _hex(meta.block_id.hash) if meta else "",
                 "latest_app_hash": _hex(meta.header.app_hash) if meta else "",
                 "latest_block_time": meta.header.time_ns if meta else 0,
-                "catching_up": not n.consensus.is_running,
+                # a post-upgrade sequencer-mode node is NOT catching up:
+                # BFT is stopped by design (readiness tooling gates on
+                # this — it must not drain every upgraded node forever)
+                "catching_up": not (
+                    n.consensus.is_running or _seq_started(n)
+                ),
+                # morph: post-upgrade sequencer mode (StateV2); height is
+                # the V2 (L2) chain head this node has applied
+                "sequencer_mode": _seq_started(n),
+                "v2_height": (
+                    n.state_v2.latest_height()
+                    if getattr(n, "state_v2", None) is not None
+                    else 0
+                ),
             },
             "validator_info": {
                 "address": _hex(pv_pub.address()),

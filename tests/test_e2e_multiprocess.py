@@ -104,8 +104,14 @@ def _spawn(home: str):
         log.close()  # the child holds its own inherited descriptor
 
 
-def test_multiprocess_testnet_kill9_restart(tmp_path):
-    base = str(tmp_path / "net")
+def _boot_testnet(base, chain_id, configure_node=None):
+    """Generate an N-node testnet, rewrite its fixed ports to free
+    ephemeral ones (parallel CI runs must not collide), apply the
+    per-node `configure_node(i, cfg, homes)` hook, and return
+    (homes, rpc_ports, peers)."""
+    from tendermint_tpu.config import Config
+    from tendermint_tpu.p2p.key import NodeKey
+
     rc = subprocess.run(
         [
             sys.executable,
@@ -117,18 +123,13 @@ def test_multiprocess_testnet_kill9_restart(tmp_path):
             "--output",
             base,
             "--chain-id",
-            "mp-e2e",
+            chain_id,
         ],
         cwd=REPO,
         capture_output=True,
         timeout=120,
     )
     assert rc.returncode == 0, rc.stderr.decode()
-
-    # rewrite the generated fixed ports to free ephemeral ones (parallel
-    # CI runs must not collide)
-    from tendermint_tpu.config import Config
-    from tendermint_tpu.p2p.key import NodeKey
 
     ports = _free_ports(2 * N)
     p2p_ports = ports[:N]
@@ -147,7 +148,15 @@ def test_multiprocess_testnet_kill9_restart(tmp_path):
         cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_ports[i]}"
         cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_ports[i]}"
         cfg.p2p.persistent_peers = peers
+        if configure_node is not None:
+            configure_node(i, cfg, homes)
         cfg.save()
+    return homes, rpc_ports, peers
+
+
+def test_multiprocess_testnet_kill9_restart(tmp_path):
+    base = str(tmp_path / "net")
+    homes, rpc_ports, peers = _boot_testnet(base, "mp-e2e")
 
     procs = {i: _spawn(homes[i]) for i in range(N)}
     try:
@@ -236,6 +245,75 @@ def test_multiprocess_testnet_kill9_restart(tmp_path):
             assert False, "statesync node has genesis-era blocks"
         except RuntimeError:
             pass  # -32000 no block — expected
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                os.killpg(p.pid, signal.SIGKILL)
+
+
+def test_multiprocess_upgrade_switch_to_sequencer(tmp_path):
+    """The Morph upgrade across real processes (reference upgrade/ +
+    sequencer handoff): a 4-validator net commits through switch_height,
+    every node stops BFT, the keyed node becomes THE sequencer producing
+    ECDSA-signed BlockV2s, and the other three follow via the broadcast
+    reactor over p2p — asserted through the new status RPC fields."""
+    from tendermint_tpu.crypto import secp256k1
+    from tendermint_tpu.sequencer import LocalSigner
+
+    seq_key = secp256k1.PrivKey.from_secret(b"mp-sequencer")
+    seq_addr = LocalSigner(seq_key).address().hex()
+    SWITCH = 4
+
+    def configure(i, cfg, homes):
+        cfg.consensus.switch_height = SWITCH
+        cfg.sequencer.block_interval = 0.2
+        cfg.sequencer.sequencer_addresses = seq_addr
+        if i == 0:
+            with open(
+                os.path.join(homes[i], "config", "sequencer_key"), "w"
+            ) as f:
+                f.write(seq_key.bytes().hex())
+            cfg.sequencer.sequencer_key_file = "config/sequencer_key"
+
+    base = str(tmp_path / "net")
+    homes, rpc_ports, peers = _boot_testnet(
+        base, "mp-upgrade", configure_node=configure
+    )
+
+    procs = {i: _spawn(homes[i]) for i in range(N)}
+    try:
+        # BFT runs to the switch; then every node reports sequencer mode
+        # and the V2 chain advances past the BFT head on ALL nodes
+        t0 = time.monotonic()
+        last = {}
+        while time.monotonic() - t0 < 210:
+            # a crashed node must not keep counting via stale samples
+            assert all(
+                pr.poll() is None for pr in procs.values()
+            ), "a node process died during the switch"
+            done = 0
+            for p in rpc_ports:
+                try:
+                    si = _rpc(p, "status")["sync_info"]
+                    last[p] = (
+                        si["latest_block_height"],
+                        si["sequencer_mode"],
+                        si["v2_height"],
+                    )
+                except Exception:
+                    last[p] = last.get(p, (0, False, 0))
+                h_, seq, v2 = last[p]
+                if seq and v2 >= SWITCH + 3:
+                    done += 1
+            if done == len(rpc_ports):
+                break
+            time.sleep(1.0)
+        else:
+            raise TimeoutError(f"sequencer switch never converged: {last}")
+
+        # BFT stopped at the switch height everywhere
+        for p in rpc_ports:
+            assert last[p][0] <= SWITCH, last
     finally:
         for p in procs.values():
             if p.poll() is None:
