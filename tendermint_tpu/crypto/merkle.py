@@ -36,15 +36,90 @@ def _split_point(n: int) -> int:
     return k
 
 
+# Batches >= this many leaves hash their LEAVES on device (one fused
+# ragged-batch SHA-256 program — ops/sha256.py); the shallow fold stays on
+# host. 0 (default) = all-host. The knob for real silicon, where the
+# device outruns hashlib on the bulk leaf pass of large tx lists / part
+# sets (the saturation-benchmark shape); on this harness's executor the
+# host wins (see tendermint-tpu perf notes), so it stays off unless set.
+import os as _os
+
+DEVICE_LEAF_MIN = int(_os.environ.get("TM_TPU_DEVICE_MERKLE_MIN", "0") or 0)
+# one oversized leaf would pad EVERY row's buffer to its length class
+# (same rationale/cap as the device SHA-512 path, batch_verifier.py)
+DEVICE_LEAF_MAX_BYTES = 2048
+
+_device_warned = False
+
+
 def hash_from_byte_slices(items: list[bytes]) -> bytes:
     n = len(items)
     if n == 0:
         return _sha256(b"")
+    leaves = None
+    if (
+        DEVICE_LEAF_MIN
+        and n >= DEVICE_LEAF_MIN
+        and max(len(x) for x in items) <= DEVICE_LEAF_MAX_BYTES
+    ):
+        try:
+            leaves = _device_leaf_hashes(items)
+        except Exception as e:  # no usable device: the host path is exact
+            global _device_warned
+            if not _device_warned:
+                _device_warned = True
+                import warnings
+
+                warnings.warn(
+                    "TM_TPU_DEVICE_MERKLE_MIN is set but the device leaf "
+                    f"path failed ({e!r}); falling back to host hashing"
+                )
+    if leaves is None:
+        leaves = [leaf_hash(x) for x in items]
+    return _root_from_leaf_hashes(leaves)
+
+
+# shape buckets so the jitted kernel compiles a handful of programs, not
+# one per (batch, length-class) pair — tx counts vary every block
+_LEAF_BATCH_BUCKETS = (64, 256, 1024, 4096, 16384)
+
+
+def _device_leaf_hashes(items: list[bytes]) -> list[bytes]:
+    """All RFC 6962 leaf hashes as ONE device batch (0x00-prefixed,
+    ragged lengths padded host-side — ops/sha256.pad_messages), with the
+    batch and block-count axes padded up to buckets."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import sha256 as dsha
+
+    n = len(items)
+    b = next((x for x in _LEAF_BATCH_BUCKETS if x >= n), None)
+    if b is None:
+        q = _LEAF_BATCH_BUCKETS[-1]
+        b = ((n + q - 1) // q) * q
+    buf, counts = dsha.pad_messages(items + [b""] * (b - n), prefix=b"\x00")
+    # round the block axis up to a power of two (length classes)
+    nblk = buf.shape[1] // 64
+    nblk_b = 1
+    while nblk_b < nblk:
+        nblk_b *= 2
+    if nblk_b != nblk:
+        buf = np.pad(buf, ((0, 0), (0, (nblk_b - nblk) * 64)))
+    out = np.asarray(
+        dsha.sha256_batch_jit(jnp.asarray(buf), jnp.asarray(counts))
+    )
+    return [bytes(row) for row in out[:n]]
+
+
+def _root_from_leaf_hashes(leaves: list[bytes]) -> bytes:
+    """RFC 6962 fold over precomputed leaf hashes (n >= 1)."""
+    n = len(leaves)
     if n == 1:
-        return leaf_hash(items[0])
+        return leaves[0]
     k = _split_point(n)
     return inner_hash(
-        hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:])
+        _root_from_leaf_hashes(leaves[:k]), _root_from_leaf_hashes(leaves[k:])
     )
 
 
