@@ -205,11 +205,16 @@ def cmd_show_validator(args) -> int:
 
 def cmd_light(args) -> int:
     """Run a light-client proxy (reference cmd light.go + light/proxy)."""
+    from .crypto._native_build import preload_in_background
     from .light.client import LightClient, TrustOptions
     from .light.proxy import LightProxy
     from .light.store import LightStore
     from .rpc.light_provider import RPCProvider
     from .store.kv import SqliteKV
+
+    # warm the native crypto libs off-thread: first-use otherwise pays
+    # a synchronous g++ compile inline on the verify path
+    preload_in_background()
 
     os.makedirs(args.home, exist_ok=True)
     store = LightStore(SqliteKV(os.path.join(args.home, "light.db")))

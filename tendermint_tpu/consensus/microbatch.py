@@ -41,6 +41,7 @@ class MicroBatcher:
         self.logger = logger or nop_logger()
         self.error_verdict = error_verdict
         self._queue: list[tuple[object, asyncio.Future]] = []
+        self._inflight: list[tuple[object, asyncio.Future]] = []
         self._wakeup: Optional[asyncio.Event] = None
         self._worker: Optional[asyncio.Task] = None
         # telemetry: recent batch sizes (bounded; metrics hook + tests)
@@ -74,26 +75,40 @@ class MicroBatcher:
             )
             items = [it for it, _ in batch]
             self.batch_sizes.append(len(items))
+            self._inflight = batch
             try:
                 # the verify call blocks; run it off-loop so more items
                 # can queue meanwhile (they become the next batch)
                 verdicts = await asyncio.get_running_loop().run_in_executor(
                     None, self._verify_items, items
                 )
+            except asyncio.CancelledError:
+                # stop() cancelled us mid-verify: resolve the dequeued
+                # batch before unwinding, or its submitters hang forever
+                self._resolve_error(batch)
+                self._inflight = []
+                raise
             except Exception as e:  # verifier failure: don't crash the loop
                 self.logger.error("micro-batch verify failed", err=repr(e))
                 verdicts = [self.error_verdict] * len(items)
+            self._inflight = []
             for (_, fut), valid in zip(batch, verdicts):
                 if not fut.cancelled():
                     fut.set_result(valid)
+
+    def _resolve_error(self, batch: list) -> None:
+        for _, fut in batch:
+            if not fut.done():
+                fut.set_result(self.error_verdict)
 
     def stop(self) -> None:
         if self._worker is not None:
             self._worker.cancel()
             self._worker = None
-        # resolve anything still queued so awaiting submitters don't hang
-        # through shutdown (they see the error verdict, which is safe)
+        # resolve the in-flight batch and anything still queued so
+        # awaiting submitters don't hang through shutdown (they see the
+        # error verdict, which is safe)
+        inflight, self._inflight = self._inflight, []
+        self._resolve_error(inflight)
         pending, self._queue = self._queue, []
-        for _, fut in pending:
-            if not fut.done():
-                fut.set_result(self.error_verdict)
+        self._resolve_error(pending)

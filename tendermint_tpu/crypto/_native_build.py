@@ -1,0 +1,142 @@
+"""Shared build-or-load machinery for the native C++ fast paths.
+
+Each native library (_tmbls.so, _tmsecp.so, _tmcrypto.so) is compiled
+from native/<name>.cpp on first use. Staleness is decided by a SHA-256
+of the source embedded in a sidecar file (<so>.srchash), not by mtimes:
+a fresh clone gives source and .so identical checkout mtimes, which
+under an mtime rule would silently keep loading a stale committed
+binary after source edits (advisor finding, round 3). Content hashing
+makes the decision deterministic and clone-safe.
+
+Loads return None when neither a matching .so nor a compiler is
+available; callers fall back to pure Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+
+def _src_hash(src: str) -> Optional[str]:
+    try:
+        with open(src, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def _stored_hash(so_path: str) -> Optional[str]:
+    try:
+        with open(so_path + ".srchash", "r") as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def build_or_load(so_name: str, src_name: str, timeout: int = 180) -> Optional[ctypes.CDLL]:
+    """Compile native/<src_name> into tendermint_tpu/<so_name> if the
+    source hash differs from the recorded one, then dlopen it."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_root = os.path.dirname(pkg_root)
+    so_path = os.path.join(pkg_root, so_name)
+    src = os.path.join(repo_root, "native", src_name)
+
+    want = _src_hash(src)
+    have_so = os.path.exists(so_path)
+    fresh = have_so and want is not None and _stored_hash(so_path) == want
+    if not fresh:
+        if want is None and not have_so:
+            return None
+        if want is not None:
+            # compile to a pid-suffixed temp and rename into place: the
+            # .so lives in the shared package dir, so a concurrent
+            # process (multi-node testnet from one checkout) must never
+            # dlopen a half-written file or interleave two g++ links
+            tmp = so_path + f".build.{os.getpid()}"
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                    check=True,
+                    capture_output=True,
+                    timeout=timeout,
+                )
+                os.replace(tmp, so_path)
+                with open(so_path + ".srchash", "w") as f:
+                    f.write(want)
+            except (subprocess.SubprocessError, OSError):
+                # rebuild failed (no compiler?): an existing .so is
+                # still usable as a best-effort fast path
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                if not os.path.exists(so_path):
+                    return None
+    try:
+        return ctypes.CDLL(so_path)
+    except OSError:
+        return None
+
+
+class NativeLoader:
+    """Lazy, cached, non-blocking loader for one native library.
+
+    First call compiles+loads under a lock and sets each function's
+    restype to c_int; while that (up to `timeout` seconds of g++) is in
+    flight, other threads get None immediately and use the pure-Python
+    fallback instead of stalling on the lock.
+    """
+
+    def __init__(self, so_name: str, src_name: str,
+                 funcs: Sequence[str], timeout: int = 180):
+        self.so_name = so_name
+        self.src_name = src_name
+        self.funcs = tuple(funcs)
+        self.timeout = timeout
+        self._lib: Optional[ctypes.CDLL] = None
+        self._tried = False
+        self._lock = threading.Lock()
+
+    def get(self) -> Optional[ctypes.CDLL]:
+        if self._tried:
+            return self._lib
+        if not self._lock.acquire(blocking=False):
+            return None
+        try:
+            if self._tried:
+                return self._lib
+            lib = build_or_load(self.so_name, self.src_name, self.timeout)
+            if lib is not None:
+                try:
+                    for name in self.funcs:
+                        getattr(lib, name).restype = ctypes.c_int
+                    self._lib = lib
+                except AttributeError:
+                    self._lib = None
+            self._tried = True
+            return self._lib
+        finally:
+            self._lock.release()
+
+
+def preload_in_background() -> threading.Thread:
+    """Warm all native libraries from a daemon thread so entry points
+    other than the node (light proxy, tools, RPC-driven verification)
+    never pay a multi-second synchronous g++ compile inline; the pure-
+    Python fallbacks serve until each loader's first-use lock clears."""
+
+    def _warm() -> None:
+        from . import aead, bls_native, secp_native
+
+        bls_native.native_lib()
+        secp_native.native_lib()
+        aead._native_lib()
+
+    t = threading.Thread(target=_warm, name="native-preload", daemon=True)
+    t.start()
+    return t

@@ -10,54 +10,25 @@ working where no compiler exists.
 from __future__ import annotations
 
 import ctypes
-import os
 import struct
-import subprocess
-import threading
 from typing import Optional
+
+from ._native_build import NativeLoader
 
 KEY_SIZE = 32
 NONCE_SIZE = 12
 TAG_SIZE = 16
 
-_lib: Optional[ctypes.CDLL] = None
-_lib_tried = False
-_lock = threading.Lock()
+_loader = NativeLoader(
+    "_tmcrypto.so",
+    "chacha20poly1305.cpp",
+    funcs=("tm_aead_seal", "tm_aead_open"),
+    timeout=120,
+)
 
 
 def _native_lib() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_tried
-    with _lock:
-        if _lib_tried:
-            return _lib
-        _lib_tried = True
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        repo_root = os.path.dirname(pkg_root)
-        so_path = os.path.join(pkg_root, "_tmcrypto.so")
-        src = os.path.join(repo_root, "native", "chacha20poly1305.cpp")
-        if not os.path.exists(so_path):
-            if not os.path.exists(src):
-                return None
-            try:
-                subprocess.run(
-                    [
-                        "g++", "-O3", "-shared", "-fPIC",
-                        "-o", so_path, src,
-                    ],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except (subprocess.SubprocessError, OSError):
-                return None
-        try:
-            lib = ctypes.CDLL(so_path)
-            lib.tm_aead_seal.restype = ctypes.c_int
-            lib.tm_aead_open.restype = ctypes.c_int
-            _lib = lib
-        except OSError:
-            _lib = None
-        return _lib
+    return _loader.get()
 
 
 # --- pure-python fallback (RFC 8439) --------------------------------------

@@ -14,62 +14,27 @@ compiler); callers fall back to the pure-Python path.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
 from typing import Optional
 
-_lib: Optional[ctypes.CDLL] = None
-_lib_tried = False
-_lock = threading.Lock()
+from ._native_build import NativeLoader
 
-_FUNCS = (
-    "tmbls_pairing_check",
-    "tmbls_g1_mul",
-    "tmbls_g2_mul",
-    "tmbls_g1_msm",
-    "tmbls_g2_msm",
-    "tmbls_g1_check",
-    "tmbls_g2_check",
+_loader = NativeLoader(
+    "_tmbls.so",
+    "bls12_381.cpp",
+    funcs=(
+        "tmbls_pairing_check",
+        "tmbls_g1_mul",
+        "tmbls_g2_mul",
+        "tmbls_g1_msm",
+        "tmbls_g2_msm",
+        "tmbls_g1_check",
+        "tmbls_g2_check",
+    ),
 )
 
 
 def native_lib() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_tried
-    with _lock:
-        if _lib_tried:
-            return _lib
-        _lib_tried = True
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        repo_root = os.path.dirname(pkg_root)
-        so_path = os.path.join(pkg_root, "_tmbls.so")
-        src = os.path.join(repo_root, "native", "bls12_381.cpp")
-        if not os.path.exists(so_path) or (
-            os.path.exists(src)
-            and os.path.getmtime(src) > os.path.getmtime(so_path)
-        ):
-            if not os.path.exists(src) and not os.path.exists(so_path):
-                return None
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", so_path, src],
-                    check=True,
-                    capture_output=True,
-                    timeout=180,
-                )
-            except (subprocess.SubprocessError, OSError):
-                # rebuild failed (no compiler?): an existing .so — e.g.
-                # checked out with arbitrary mtimes — is still usable
-                if not os.path.exists(so_path):
-                    return None
-        try:
-            lib = ctypes.CDLL(so_path)
-            for name in _FUNCS:
-                getattr(lib, name).restype = ctypes.c_int
-            _lib = lib
-        except (OSError, AttributeError):
-            _lib = None
-        return _lib
+    return _loader.get()
 
 
 def pairing_check(g1s: bytes, g2s: bytes, n: int) -> Optional[bool]:

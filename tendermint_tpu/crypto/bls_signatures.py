@@ -503,12 +503,15 @@ class BLSKeyRegistry:
         self._by_tm[bytes(tm_pubkey)] = pub
 
     def verifier(self):
-        """(tm_pubkey, message, sig_bytes) -> bool, for MockL2Node."""
+        """(tm_pubkey, message, sig_bytes) -> bool|None, for MockL2Node.
+        None = tm key not registered (registry lag for a newly added
+        validator is not a cryptographic rejection — the relaying peer
+        must not be punished for it)."""
 
-        def _verify(tm_pubkey: bytes, message: bytes, sig_bytes: bytes) -> bool:
+        def _verify(tm_pubkey: bytes, message: bytes, sig_bytes: bytes):
             pub = self._by_tm.get(bytes(tm_pubkey))
             if pub is None:
-                return False
+                return None
             try:
                 s = g1_from_bytes(bytes(sig_bytes))
             except BLSError:
@@ -524,12 +527,13 @@ class BLSKeyRegistry:
 
         def _verify_batch(
             tm_pubkeys: list, message: bytes, sig_list: list
-        ) -> list[bool]:
-            out = [False] * len(tm_pubkeys)
+        ) -> list:
+            out: list = [False] * len(tm_pubkeys)
             idx, pubs, sigs = [], [], []
             for i, (tk, sb) in enumerate(zip(tm_pubkeys, sig_list)):
                 pub = self._by_tm.get(bytes(tk))
                 if pub is None:
+                    out[i] = None  # unknown key: not a crypto rejection
                     continue
                 try:
                     s = g1_from_bytes(bytes(sb))

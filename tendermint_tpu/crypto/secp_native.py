@@ -14,53 +14,18 @@ from __future__ import annotations
 
 import ctypes
 import hashlib
-import os
-import subprocess
-import threading
 from typing import Optional
 
+from ._native_build import NativeLoader
 from .secp256k1 import N, _HALF_N, decompress_point, verify_digest
 
-_lib: Optional[ctypes.CDLL] = None
-_lib_tried = False
-_lock = threading.Lock()
+_loader = NativeLoader(
+    "_tmsecp.so", "secp256k1.cpp", funcs=("tmsecp_shamir_batch",)
+)
 
 
 def native_lib() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_tried
-    with _lock:
-        if _lib_tried:
-            return _lib
-        _lib_tried = True
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        repo_root = os.path.dirname(pkg_root)
-        so_path = os.path.join(pkg_root, "_tmsecp.so")
-        src = os.path.join(repo_root, "native", "secp256k1.cpp")
-        if not os.path.exists(so_path) or (
-            os.path.exists(src)
-            and os.path.getmtime(src) > os.path.getmtime(so_path)
-        ):
-            if not os.path.exists(src) and not os.path.exists(so_path):
-                return None
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", so_path, src],
-                    check=True,
-                    capture_output=True,
-                    timeout=180,
-                )
-            except (subprocess.SubprocessError, OSError):
-                # rebuild failed (no compiler?): an existing .so — e.g.
-                # checked out with arbitrary mtimes — is still usable
-                if not os.path.exists(so_path):
-                    return None
-        try:
-            lib = ctypes.CDLL(so_path)
-            lib.tmsecp_shamir_batch.restype = ctypes.c_int
-            _lib = lib
-        except (OSError, AttributeError):
-            _lib = None
-        return _lib
+    return _loader.get()
 
 
 def verify_msgs_batch(

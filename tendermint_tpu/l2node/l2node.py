@@ -67,16 +67,22 @@ class L2Node(Protocol):
 
     def verify_signature(
         self, tm_pubkey: bytes, message_hash: bytes, signature: bytes
-    ) -> bool:
+    ) -> "bool | None":
         """Verify a validator's BLS signature over a batch hash
         (reference l2node.go VerifySignature; called per precommit in
-        consensus/state.go:2362-2379)."""
+        consensus/state.go:2362-2379).
+
+        Tri-state verdict: True/False are definitive cryptographic
+        verdicts; None means the verifier could not decide (tm key not
+        yet in the BLS registry, L2 unreachable). Callers reject the
+        vote on None (falsy) but must not punish the relaying peer —
+        only False justifies a disconnect."""
         ...
 
     def verify_signatures(
         self, tm_pubkeys: list[bytes], message_hash: bytes,
         signatures: list[bytes],
-    ) -> list[bool]:
+    ) -> "list[bool | None]":
         """Batched form of verify_signature over ONE message: per-index
         verdicts. TPU-framework extension of the reference port (which
         only verifies serially, l2node.go VerifySignature): the consensus

@@ -81,13 +81,14 @@ class MockL2Node:
 
     # --- BLS --------------------------------------------------------------
 
-    def verify_signature(self, tm_pubkey, message_hash, signature) -> bool:
+    def verify_signature(self, tm_pubkey, message_hash, signature):
         if self._bls_verifier is not None:
             return self._bls_verifier(tm_pubkey, message_hash, signature)
-        # No registry configured: reject. (A batch-point flow without BLS
-        # keys is a misconfiguration — never silently accept; see
-        # crypto/bls_signatures.BLSKeyRegistry for the real wiring.)
-        return False
+        # No registry configured: verdict is unknown (None), never a
+        # cryptographic rejection — callers drop the vote (falsy) but
+        # don't disconnect the relaying peer over a wiring gap; see
+        # crypto/bls_signatures.BLSKeyRegistry for the real wiring.
+        return None
 
     def verify_signatures(self, tm_pubkeys, message_hash, signatures):
         if self._bls_batch_verifier is not None:

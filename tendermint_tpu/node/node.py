@@ -110,13 +110,15 @@ class Node(Service):
         # blssignatures.KeyFile at startup and refuses to run without it).
         # Loaded (or generated, like the other key files) so the assembled
         # node actually dual-signs batch-point precommits.
-        from ..crypto import bls_native, secp_native
+        from ..crypto import aead, bls_native, secp_native
         from ..crypto import bls_signatures as bls
 
         # build/load the native crypto NOW, not on the event loop
-        # mid-consensus (the first call may invoke g++ for seconds)
+        # mid-consensus (the first call may invoke g++ for seconds);
+        # aead backs every p2p secret-connection frame
         bls_native.native_lib()
         secp_native.native_lib()
+        aead._native_lib()
         # export the fused device-SHA-512 knob before the first
         # default_verifier() constructs the process-wide verifier
         if config.base.device_challenge_min > 0:

@@ -304,9 +304,14 @@ def test_registry_batch_verifier_unknown_key_and_bad_encoding():
     s1 = bls.g1_to_bytes(bls.sign(privs[1], msg))
     vb = reg.batch_verifier()
     assert vb([b"tm0", b"tm1"], msg, [s0, s1]) == [True, True]
-    # unknown key, garbage encoding, swapped sig
+    # unknown key -> None (not a crypto rejection: registry lag must not
+    # punish the relaying peer), garbage encoding / swapped sig -> False
     assert vb([b"tmX", b"tm1", b"tm0"], msg, [s0, b"\x01" * 96, s1]) == [
-        False,
+        None,
         False,
         False,
     ]
+    v1 = reg.verifier()
+    assert v1(b"tmX", msg, s0) is None
+    assert v1(b"tm0", msg, s1) is False
+    assert v1(b"tm0", msg, s0) is True
