@@ -331,26 +331,34 @@ static bool f12_is_one(const fp12 &a) {
     return true;
 }
 
-// schoolbook over w with w^6 = XI (mirror of python f12_mul)
+// Karatsuba over the even/odd split: a = E_a(v) + w*O_a(v) with
+// E, O in Fp6 = Fp2[v]/(v^3 - XI), v = w^2, so
+//   a*b = (E_a E_b + v O_a O_b) + w ((E_a+O_a)(E_b+O_b) - E_a E_b - O_a O_b)
+// 3 Fp6 muls (18 f2 muls) vs the 36 of schoolbook over w.
+static void f6_mul(fp2 o[3], const fp2 a[3], const fp2 b[3]);
+static void f6_mul_by_v(fp2 o[3], const fp2 a[3]);
+
 static void f12_mul(fp12 &o, const fp12 &a, const fp12 &b) {
-    fp2 acc[11];
-    for (int k = 0; k < 11; k++) acc[k] = F2_ZERO_C;
-    for (int i = 0; i < 6; i++) {
-        if (f2_is_zero(a.c[i])) continue;
-        for (int j = 0; j < 6; j++) {
-            if (f2_is_zero(b.c[j])) continue;
-            fp2 m;
-            f2_mul(m, a.c[i], b.c[j]);
-            f2_add(acc[i + j], acc[i + j], m);
-        }
+    fp2 Ea[3] = {a.c[0], a.c[2], a.c[4]};
+    fp2 Oa[3] = {a.c[1], a.c[3], a.c[5]};
+    fp2 Eb[3] = {b.c[0], b.c[2], b.c[4]};
+    fp2 Ob[3] = {b.c[1], b.c[3], b.c[5]};
+    fp2 EE[3], OO[3], sa[3], sb[3], m[3], vOO[3];
+    f6_mul(EE, Ea, Eb);
+    f6_mul(OO, Oa, Ob);
+    for (int i = 0; i < 3; i++) {
+        f2_add(sa[i], Ea[i], Oa[i]);
+        f2_add(sb[i], Eb[i], Ob[i]);
     }
-    for (int k = 0; k < 6; k++) {
-        if (k + 6 <= 10) {
-            fp2 hx;
-            f2_mul_xi(hx, acc[k + 6]);
-            f2_add(acc[k], acc[k], hx);
-        }
-        o.c[k] = acc[k];
+    f6_mul(m, sa, sb);
+    f6_mul_by_v(vOO, OO);
+    for (int i = 0; i < 3; i++) {
+        fp2 even, odd;
+        f2_add(even, EE[i], vOO[i]);
+        f2_sub(odd, m[i], EE[i]);
+        f2_sub(odd, odd, OO[i]);
+        o.c[2 * i] = even;
+        o.c[2 * i + 1] = odd;
     }
 }
 
@@ -559,15 +567,79 @@ static void f12_inv(fp12 &o, const fp12 &a) {
     f12_mul(o, ac, n12);
 }
 
-// a^|x| by square-and-multiply over X_ABS's bits
-static void f12_exp_xabs(fp12 &o, const fp12 &a) {
+// --- cyclotomic squaring (Granger–Scott) ---------------------------------
+//
+// After the easy part of the final exponentiation the element lies in the
+// cyclotomic subgroup G_{Phi12}(p), where squaring collapses to 3 Fp4
+// squarings (18 fp muls) instead of the generic 2 Fp6 muls (36 fp muls).
+// View Fp12 = Fp4[w]/(w^3 - z) with Fp4 = Fp2[z]/(z^2 - XI), z = w^3:
+//   alpha = A + B w + C w^2,  A = c0 + c3 z, B = c1 + c4 z, C = c2 + c5 z
+// and for unitary alpha (Granger–Scott 2010, Thm 3.2):
+//   alpha^2 = (3A^2 - 2conj(A)) + (3 z C^2 + 2conj(B)) w + (3B^2 - 2conj(C)) w^2
+// with conj the Fp4 conjugation z -> -z.
+
+// (a + b z)^2 = (a^2 + XI b^2) + (2ab) z  — 3 Fp2 squarings via c1 trick
+static inline void f4_sqr(fp2 &o0, fp2 &o1, const fp2 &a, const fp2 &b) {
+    fp2 t0, t1, s;
+    f2_sqr(t0, a);
+    f2_sqr(t1, b);
+    f2_add(s, a, b);
+    f2_sqr(o1, s);
+    f2_sub(o1, o1, t0);
+    f2_sub(o1, o1, t1); // 2ab
+    f2_mul_xi(s, t1);
+    f2_add(o0, t0, s); // a^2 + XI b^2
+}
+
+// h_re = 3 t_re - 2 a_re;  h_im = 3 t_im + 2 a_im  (the GS recombination)
+static inline void gs_comb(fp2 &hre, fp2 &him, const fp2 &tre,
+                           const fp2 &tim, const fp2 &are, const fp2 &aim) {
+    fp2 u;
+    f2_sub(u, tre, are);
+    f2_add(u, u, u);
+    f2_add(hre, u, tre);
+    f2_add(u, tim, aim);
+    f2_add(u, u, u);
+    f2_add(him, u, tim);
+}
+
+// ONLY valid for elements of the cyclotomic subgroup
+static void f12_cyclo_sqr(fp12 &o, const fp12 &a) {
+    fp2 A0, A1, B0, B1, C0, C1;
+    f4_sqr(A0, A1, a.c[0], a.c[3]); // A^2
+    f4_sqr(B0, B1, a.c[1], a.c[4]); // B^2
+    f4_sqr(C0, C1, a.c[2], a.c[5]); // C^2
+    // w^0/w^3 slots: 3A^2 - 2conj(A)
+    gs_comb(o.c[0], o.c[3], A0, A1, a.c[0], a.c[3]);
+    // w^2/w^5 slots: 3B^2 - 2conj(C)
+    gs_comb(o.c[2], o.c[5], B0, B1, a.c[2], a.c[5]);
+    // w^1/w^4 slots: 3 z C^2 + 2conj(B) with z C^2 = XI*C1 + C0 z, i.e.
+    // re' = XI*C1, im' = C0; conj(B) adds +2 c1 re / -2 c4 im — that is
+    // gs_comb with the roles of add/sub swapped, so inline it:
+    {
+        fp2 re, u;
+        f2_mul_xi(re, C1);
+        f2_add(u, re, a.c[1]);
+        f2_add(u, u, u);
+        f2_add(o.c[1], u, re);
+        f2_sub(u, C0, a.c[4]);
+        f2_add(u, u, u);
+        f2_add(o.c[4], u, C0);
+    }
+}
+
+// a^|x| by square-and-multiply over X_ABS's bits; cyclo=true uses the
+// Granger–Scott squaring (caller guarantees a is in the cyclotomic
+// subgroup — true throughout the final-exponentiation hard part)
+static void f12_exp_xabs(fp12 &o, const fp12 &a, bool cyclo) {
     fp12 r;
     f12_one(r);
     int top = 63;
     while (!((X_ABS >> top) & 1)) top--;
     for (int i = top; i >= 0; i--) {
         fp12 t;
-        f12_sqr(t, r);
+        if (cyclo) f12_cyclo_sqr(t, r);
+        else f12_sqr(t, r);
         r = t;
         if ((X_ABS >> i) & 1) {
             f12_mul(t, r, a);
@@ -580,7 +652,7 @@ static void f12_exp_xabs(fp12 &o, const fp12 &a) {
 // a^x for the negative BLS parameter (conj == inverse for unitary elts)
 static void f12_exp_x_signed(fp12 &o, const fp12 &a) {
     fp12 t;
-    f12_exp_xabs(t, a);
+    f12_exp_xabs(t, a, true);
     f12_conj(o, t);
 }
 
@@ -610,7 +682,7 @@ static void final_exponentiation(fp12 &o, const fp12 &f_in) {
     f12_mul(c, c, t);
     f12_conj(t, b);
     f12_mul(c, c, t); // ^(x^2+p^2-1)
-    f12_sqr(t, f);
+    f12_cyclo_sqr(t, f);
     f12_mul(t, t, f); // f^3
     f12_mul(o, c, t);
 }
@@ -1035,30 +1107,117 @@ static bool g2_in_subgroup(const g2 &p) {
 
 // --- Miller loop + pairing ----------------------------------------------
 
-// line through the twist point (xt,yt) with slope lam, evaluated at
-// affine P=(xp,yp):  l = (lam*xt - yt) - (lam*xp) w^2 + yp w^3
-static void line_eval(fp2 &l0, fp2 &l2, fp2 &l3, const fp2 &lam,
-                      const fp2 &xt, const fp2 &yt, const fp &xp,
-                      const fp &yp) {
-    fp2 t;
-    f2_mul(t, lam, xt);
-    f2_sub(l0, t, yt);
-    f2_scale(t, lam, xp);
+// Inversion-free Miller loop: T is tracked in Jacobian coordinates and
+// the affine line l = (lam*xt - yt) - (lam*xp) w^2 + yp w^3 is used in a
+// version scaled by its denominator (2*Y*Z^3 for doubling, Z*lambda for
+// addition). The scale is an Fp2 element, and any Fp2 factor of f dies
+// in the easy part of the final exponentiation (c^(p^6-1) = 1 for
+// c in Fp2), so the pairing value is unchanged — this replaces ~130
+// binary-extgcd field inversions (~20 us each) per 2-pairing check.
+
+// doubling step: line coefficients + T <- 2T (standard Jacobian dbl)
+static void miller_dbl_step(fp2 &l0, fp2 &l2, fp2 &l3, fp2 &X, fp2 &Y,
+                            fp2 &Z, const fp &xp, const fp &yp) {
+    fp2 A, B, C, D, E, F, Zsq, Z3, t;
+    f2_sqr(A, X);
+    f2_sqr(B, Y);
+    f2_sqr(C, B);
+    f2_add(t, X, B);
+    f2_sqr(t, t);
+    f2_sub(t, t, A);
+    f2_sub(t, t, C);
+    f2_add(D, t, t);
+    f2_add(E, A, A);
+    f2_add(E, E, A); // 3 X^2
+    f2_sqr(F, E);
+    f2_sqr(Zsq, Z);
+    // L0 = E*X - 2B  (= 3X^3 - 2Y^2, the line scaled by 2 Y Z^3)
+    f2_mul(l0, E, X);
+    f2_sub(l0, l0, B);
+    f2_sub(l0, l0, B);
+    // L2 = -E * Z^2 * xp
+    f2_mul(t, E, Zsq);
+    f2_scale(t, t, xp);
     f2_neg(l2, t);
-    l3.c0 = yp;
-    l3.c1 = FP_ZERO;
+    // Z3 = 2 Y Z;  L3 = Z3 * Z^2 * yp
+    f2_mul(Z3, Y, Z);
+    f2_add(Z3, Z3, Z3);
+    f2_mul(t, Z3, Zsq);
+    f2_scale(l3, t, yp);
+    // X3 = F - 2D; Y3 = E (D - X3) - 8C
+    f2_sub(X, F, D);
+    f2_sub(X, X, D);
+    f2_sub(t, D, X);
+    f2_mul(Y, E, t);
+    f2_add(C, C, C);
+    f2_add(C, C, C);
+    f2_add(C, C, C);
+    f2_sub(Y, Y, C);
+    Z = Z3;
 }
 
-// prod_i f_{|x|,Q_i}(P_i), conjugated for x<0; inputs affine, n <= 64
+// addition step T <- T + Q (Q affine) + line through T and Q
+static void miller_add_step(fp2 &l0, fp2 &l2, fp2 &l3, fp2 &X, fp2 &Y,
+                            fp2 &Z, const fp2 &xq, const fp2 &yq,
+                            const fp &xp, const fp &yp) {
+    fp2 Zsq, Zcu, theta, lam, Zlam, t;
+    f2_sqr(Zsq, Z);
+    f2_mul(Zcu, Zsq, Z);
+    // theta = Y - yq Z^3 (slope numerator * Z^3), lam = X - xq Z^2
+    f2_mul(t, yq, Zcu);
+    f2_sub(theta, Y, t);
+    f2_mul(t, xq, Zsq);
+    f2_sub(lam, X, t);
+    f2_mul(Zlam, Z, lam);
+    // line scaled by Z*lam: L0 = theta*xq - Zlam*yq, L2 = -theta*xp,
+    // L3 = Zlam*yp  (evaluated through Q, which lies on the same line)
+    f2_mul(l0, theta, xq);
+    f2_mul(t, Zlam, yq);
+    f2_sub(l0, l0, t);
+    f2_scale(t, theta, xp);
+    f2_neg(l2, t);
+    f2_scale(l3, Zlam, yp);
+    // mixed Jacobian update with h = -lam, r = -2*theta
+    fp2 h, hh, i, j, r, v, X3, Y3, Z3;
+    f2_neg(h, lam);
+    f2_sqr(hh, h);
+    f2_add(i, hh, hh);
+    f2_add(i, i, i); // 4 h^2
+    f2_mul(j, h, i);
+    f2_neg(r, theta);
+    f2_add(r, r, r);
+    f2_mul(v, X, i);
+    f2_sqr(X3, r);
+    f2_sub(X3, X3, j);
+    f2_sub(X3, X3, v);
+    f2_sub(X3, X3, v);
+    f2_sub(t, v, X3);
+    f2_mul(Y3, r, t);
+    f2_mul(t, Y, j);
+    f2_add(t, t, t);
+    f2_sub(Y3, Y3, t);
+    f2_mul(Z3, Z, h);
+    f2_add(Z3, Z3, Z3);
+    X = X3;
+    Y = Y3;
+    Z = Z3;
+}
+
+// prod_i f_{|x|,Q_i}(P_i), conjugated for x<0; inputs affine, n <= 64.
+// Degenerate inputs (T meeting ±Q mid-loop — impossible for subgroup
+// points under |x| < r) produce a zero line factor, making the check
+// fail closed rather than divide by zero.
 static void miller_loop(fp12 &f, const fp g1x[], const fp g1y[],
                         fp2 g2x[], fp2 g2y[], int n) {
     f12_one(f);
     if (n == 0) return;
-    // T_i start at Q_i (affine Fp2 coords)
-    fp2 tx[64], ty[64];
+    // T_i start at Q_i (Z = 1)
+    fp2 tx[64], ty[64], tz[64];
     for (int i = 0; i < n; i++) {
         tx[i] = g2x[i];
         ty[i] = g2y[i];
+        tz[i].c0 = FP_ONE_MONT;
+        tz[i].c1 = FP_ZERO;
     }
     int top = 63;
     while (!((X_ABS >> top) & 1)) top--;
@@ -1067,49 +1226,19 @@ static void miller_loop(fp12 &f, const fp g1x[], const fp g1y[],
         f12_sqr(t, f);
         f = t;
         for (int i = 0; i < n; i++) {
-            // doubling: lam = 3 xt^2 / (2 yt)
-            fp2 num, den, lam, l0, l2, l3;
-            f2_sqr(num, tx[i]);
-            fp2 n3;
-            f2_add(n3, num, num);
-            f2_add(num, n3, num);
-            f2_add(den, ty[i], ty[i]);
-            f2_inv(den, den);
-            f2_mul(lam, num, den);
-            line_eval(l0, l2, l3, lam, tx[i], ty[i], g1x[i], g1y[i]);
+            fp2 l0, l2, l3;
+            miller_dbl_step(l0, l2, l3, tx[i], ty[i], tz[i],
+                            g1x[i], g1y[i]);
             f12_mul_line(t, f, l0, l2, l3);
             f = t;
-            fp2 x3, y3, s;
-            f2_sqr(x3, lam);
-            f2_add(s, tx[i], tx[i]);
-            f2_sub(x3, x3, s);
-            f2_sub(s, tx[i], x3);
-            f2_mul(y3, lam, s);
-            f2_sub(y3, y3, ty[i]);
-            tx[i] = x3;
-            ty[i] = y3;
         }
         if ((X_ABS >> bi) & 1) {
             for (int i = 0; i < n; i++) {
-                // addition T + Q: lam = (yt - yq)/(xt - xq)
-                fp2 num, den, lam, l0, l2, l3;
-                f2_sub(num, ty[i], g2y[i]);
-                f2_sub(den, tx[i], g2x[i]);
-                f2_inv(den, den);
-                f2_mul(lam, num, den);
-                line_eval(l0, l2, l3, lam, tx[i], ty[i], g1x[i], g1y[i]);
-                fp12 t;
+                fp2 l0, l2, l3;
+                miller_add_step(l0, l2, l3, tx[i], ty[i], tz[i],
+                                g2x[i], g2y[i], g1x[i], g1y[i]);
                 f12_mul_line(t, f, l0, l2, l3);
                 f = t;
-                fp2 x3, y3, s;
-                f2_sqr(x3, lam);
-                f2_sub(x3, x3, tx[i]);
-                f2_sub(x3, x3, g2x[i]);
-                f2_sub(s, tx[i], x3);
-                f2_mul(y3, lam, s);
-                f2_sub(y3, y3, ty[i]);
-                tx[i] = x3;
-                ty[i] = y3;
             }
         }
     }
@@ -1312,6 +1441,112 @@ static void g2_msm_pippenger(g2 &out, const g2 *pts,
     out = acc;
 }
 
+// --- batch-affine plain sum ----------------------------------------------
+//
+// Sum of N affine points as log2(N) halving rounds of affine+affine
+// additions sharing ONE field inversion per round (Montgomery trick):
+// lambda = (y2-y1)/(x2-x1), x3 = l^2-x1-x2, y3 = l(x1-x3)-y1 — ~6 fp2
+// muls per G2 add amortized vs ~14 for the Jacobian mixed add. This is
+// the aggregate-1000-pubkeys shape of the BLS config-3 benchmark
+// (reference does serial Jacobian adds, blssignatures.go:138-149).
+// Doubling/infinity pairs (no valid lambda) fall out of the batch and
+// resolve through the generic Jacobian path.
+
+struct g2aff { fp2 x, y; bool inf; };
+
+static void g2_sum_batch_affine(g2 &out, g2aff *p, size_t n) {
+    // scratch for the shared-inversion chain
+    fp2 *den = new (std::nothrow) fp2[n / 2 + 1];
+    fp2 *pref = new (std::nothrow) fp2[n / 2 + 2];
+    size_t *pi = new (std::nothrow) size_t[n / 2 + 1];
+    g2 extra; // jacobian accumulator for pairs the batch can't express
+    extra.x.c0 = FP_ONE_MONT; extra.x.c1 = FP_ZERO;
+    extra.y = extra.x;
+    extra.z = F2_ZERO_C;
+    if (den == nullptr || pref == nullptr || pi == nullptr) {
+        delete[] den; delete[] pref; delete[] pi;
+        // allocation-free fallback: serial mixed adds
+        g2 acc = extra;
+        for (size_t i = 0; i < n; i++) {
+            if (p[i].inf) continue;
+            g2 t;
+            g2_add_affine(t, acc, p[i].x, p[i].y);
+            acc = t;
+        }
+        out = acc;
+        return;
+    }
+    while (n > 1) {
+        size_t half = n / 2, m = 0;
+        // collect denominators x2-x1 for addable pairs (2i, 2i+1)
+        for (size_t i = 0; i < half; i++) {
+            g2aff &a = p[2 * i], &b = p[2 * i + 1];
+            if (a.inf || b.inf || f2_eq(a.x, b.x)) continue;
+            f2_sub(den[m], b.x, a.x);
+            pi[m] = i;
+            m++;
+        }
+        // prefix products + one inversion
+        pref[0].c0 = FP_ONE_MONT; pref[0].c1 = FP_ZERO;
+        for (size_t j = 0; j < m; j++)
+            f2_mul(pref[j + 1], pref[j], den[j]);
+        fp2 inv_all;
+        if (m > 0) f2_inv(inv_all, pref[m]);
+        // walk back: inv(den[j]) = pref[j] * inv(den[0..j]) suffix
+        for (size_t j = m; j-- > 0;) {
+            fp2 dj_inv;
+            f2_mul(dj_inv, pref[j], inv_all);
+            f2_mul(inv_all, inv_all, den[j]);
+            size_t i = pi[j];
+            g2aff &a = p[2 * i], &b = p[2 * i + 1];
+            fp2 lam, x3, y3, t;
+            f2_sub(t, b.y, a.y);
+            f2_mul(lam, t, dj_inv);
+            f2_sqr(x3, lam);
+            f2_sub(x3, x3, a.x);
+            f2_sub(x3, x3, b.x);
+            f2_sub(t, a.x, x3);
+            f2_mul(y3, lam, t);
+            f2_sub(y3, y3, a.y);
+            a.x = x3;
+            a.y = y3;
+            // mark consumed
+            b.inf = true;
+        }
+        // fold non-addable pairs + compact survivors to the front
+        size_t w = 0;
+        for (size_t i = 0; i < half; i++) {
+            g2aff &a = p[2 * i], &b = p[2 * i + 1];
+            if (!b.inf) {
+                // pair skipped by the batch: equal-x (double or cancel)
+                // or infinity member — route both through jacobian
+                g2 t;
+                if (!a.inf) {
+                    g2_add_affine(t, extra, a.x, a.y);
+                    extra = t;
+                }
+                g2_add_affine(t, extra, b.x, b.y);
+                extra = t;
+                continue;
+            }
+            if (a.inf) continue;
+            p[w++] = a;
+        }
+        if (n & 1) p[w++] = p[n - 1]; // odd tail carries over
+        n = w;
+    }
+    delete[] den;
+    delete[] pref;
+    delete[] pi;
+    g2 acc = extra;
+    if (n == 1 && !p[0].inf) {
+        g2 t;
+        g2_add_affine(t, acc, p[0].x, p[0].y);
+        acc = t;
+    }
+    out = acc;
+}
+
 // out = sum_i k_i * P_i  (k may be NULL for a plain sum)
 int tmbls_g1_msm(uint8_t *out, const uint8_t *pts, const uint8_t *ks,
                  size_t n) {
@@ -1373,6 +1608,23 @@ int tmbls_g2_msm(uint8_t *out, const uint8_t *pts, const uint8_t *ks,
     acc.x.c0 = FP_ONE_MONT; acc.x.c1 = FP_ZERO;
     acc.y = acc.x;
     acc.z = F2_ZERO_C;
+    if (ks == nullptr && n >= 32) {
+        g2aff *ps = new (std::nothrow) g2aff[n];
+        if (ps != nullptr) {
+            for (size_t i = 0; i < n; i++) {
+                g2 p;
+                int rc = g2_from_wire(p, pts + 192 * i);
+                if (rc < 0) { delete[] ps; return -1; }
+                ps[i].inf = (rc == 0);
+                ps[i].x = p.x;
+                ps[i].y = p.y;
+            }
+            g2_sum_batch_affine(acc, ps, n);
+            delete[] ps;
+            g2_to_wire(out, acc);
+            return 1;
+        }
+    }
     if (ks != nullptr && n >= MSM_MIN) {
         g2 *ps = new (std::nothrow) g2[n];
         uint64_t(*k)[4] = new (std::nothrow) uint64_t[n][4];
@@ -1418,6 +1670,135 @@ g2_serial:
         acc = t;
     }
     g2_to_wire(out, acc);
+    return 1;
+}
+
+// --- host helpers for the hash-to-curve path -----------------------------
+// (crypto/bls12_381.py map_to_curve_g1 keeps the SSWU/isogeny control flow
+// in python but routes the field pow/inv heavy steps and the keccak
+// absorb here; each python pow() is ~300 us vs ~20-40 us native.)
+
+// a^-1 mod p over 48-byte BE. 1 ok / 0 zero input / -1 not canonical.
+int tmbls_fp_inv48(uint8_t *out, const uint8_t *in) {
+    fp a;
+    if (fp_from_bytes(a, in) < 0) return -1;
+    if (fp_is_zero(a)) return 0;
+    fp r;
+    fp_inv(r, a);
+    fp_to_bytes(out, r);
+    return 1;
+}
+
+// sqrt(a) = a^((p+1)/4) (p = 3 mod 4). 1 ok / 0 non-square / -1 bad.
+int tmbls_fp_sqrt48(uint8_t *out, const uint8_t *in) {
+    fp a;
+    if (fp_from_bytes(a, in) < 0) return -1;
+    // e = (p+1)/4: add 1 to p's limbs, shift right twice
+    uint64_t e[6];
+    for (int i = 0; i < 6; i++) e[i] = FP_P.l[i];
+    e[0] += 1; // p ends ...aaab, no carry
+    uint64_t carry = 0;
+    for (int i = 5; i >= 0; i--) {
+        uint64_t nc = e[i] & 3;
+        e[i] = (e[i] >> 2) | (carry << 62);
+        carry = nc;
+    }
+    fp r = FP_ONE_MONT;
+    int top = 383;
+    while (top >= 0 && !((e[top / 64] >> (top % 64)) & 1)) top--;
+    for (int i = top; i >= 0; i--) {
+        fp t;
+        fp_sqr(t, r);
+        r = t;
+        if ((e[i / 64] >> (i % 64)) & 1) {
+            fp_mul(t, r, a);
+            r = t;
+        }
+    }
+    fp chk;
+    fp_sqr(chk, r);
+    if (!fp_eq(chk, a)) return 0;
+    fp_to_bytes(out, r);
+    return 1;
+}
+
+// keccak256 with the LEGACY (pre-NIST, 0x01) padding used by ethereum —
+// matches crypto/keccak.py (the reference hashes batch data the same way)
+static const uint64_t KECCAK_RC[24] = {
+    0x0000000000000001ull, 0x0000000000008082ull, 0x800000000000808aull,
+    0x8000000080008000ull, 0x000000000000808bull, 0x0000000080000001ull,
+    0x8000000080008081ull, 0x8000000000008009ull, 0x000000000000008aull,
+    0x0000000000000088ull, 0x0000000080008009ull, 0x000000008000000aull,
+    0x000000008000808bull, 0x800000000000008bull, 0x8000000000008089ull,
+    0x8000000000008003ull, 0x8000000000008002ull, 0x8000000000000080ull,
+    0x000000000000800aull, 0x800000008000000aull, 0x8000000080008081ull,
+    0x8000000000008080ull, 0x0000000080000001ull, 0x8000000080008008ull,
+};
+
+static inline uint64_t rotl64(uint64_t x, int n) {
+    return (x << n) | (x >> (64 - n));
+}
+
+static void keccak_f1600(uint64_t s[25]) {
+    static const int RHO[24] = {1, 3, 6, 10, 15, 21, 28, 36, 45, 55, 2,
+                                14, 27, 41, 56, 8, 25, 43, 62, 18, 39,
+                                61, 20, 44};
+    static const int PI[24] = {10, 7, 11, 17, 18, 3, 5, 16, 8, 21, 24,
+                               4, 15, 23, 19, 13, 12, 2, 20, 14, 22,
+                               9, 6, 1};
+    for (int rnd = 0; rnd < 24; rnd++) {
+        uint64_t bc[5];
+        for (int i = 0; i < 5; i++)
+            bc[i] = s[i] ^ s[i + 5] ^ s[i + 10] ^ s[i + 15] ^ s[i + 20];
+        for (int i = 0; i < 5; i++) {
+            uint64_t t = bc[(i + 4) % 5] ^ rotl64(bc[(i + 1) % 5], 1);
+            for (int j = 0; j < 25; j += 5) s[j + i] ^= t;
+        }
+        uint64_t t = s[1];
+        for (int i = 0; i < 24; i++) {
+            uint64_t tmp = s[PI[i]];
+            s[PI[i]] = rotl64(t, RHO[i]);
+            t = tmp;
+        }
+        for (int j = 0; j < 25; j += 5) {
+            uint64_t b0 = s[j], b1 = s[j + 1], b2 = s[j + 2], b3 = s[j + 3],
+                     b4 = s[j + 4];
+            s[j] ^= (~b1) & b2;
+            s[j + 1] ^= (~b2) & b3;
+            s[j + 2] ^= (~b3) & b4;
+            s[j + 3] ^= (~b4) & b0;
+            s[j + 4] ^= (~b0) & b1;
+        }
+        s[0] ^= KECCAK_RC[rnd];
+    }
+}
+
+int tmbls_keccak256(uint8_t *out, const uint8_t *data, size_t len) {
+    uint64_t s[25];
+    memset(s, 0, sizeof(s));
+    const size_t rate = 136;
+    while (len >= rate) {
+        for (size_t i = 0; i < rate / 8; i++) {
+            uint64_t w;
+            memcpy(&w, data + 8 * i, 8); // little-endian hosts only
+            s[i] ^= w;
+        }
+        keccak_f1600(s);
+        data += rate;
+        len -= rate;
+    }
+    uint8_t blk[136];
+    memset(blk, 0, sizeof(blk));
+    memcpy(blk, data, len);
+    blk[len] = 0x01; // legacy keccak domain padding
+    blk[rate - 1] |= 0x80;
+    for (size_t i = 0; i < rate / 8; i++) {
+        uint64_t w;
+        memcpy(&w, blk + 8 * i, 8);
+        s[i] ^= w;
+    }
+    keccak_f1600(s);
+    memcpy(out, s, 32);
     return 1;
 }
 

@@ -394,10 +394,19 @@ class BlocksyncReactor(Reactor):
             )
             if val is None:
                 continue
-            if not self.l2.verify_signature(
+            ok = self.l2.verify_signature(
                 val.pub_key.data, first.header.batch_hash, cs.bls_signature
-            ):
+            )
+            if ok is False:
+                # definitive cryptographic rejection: the peer relayed a
+                # corrupt commit
                 raise ValueError("invalid BLS signature in synced commit")
+            if ok is None:
+                # undecidable (BLS registry lag / L2 unreachable): the
+                # block itself is already proven by the ed25519 commit —
+                # drop only this L1-bound contribution, don't punish the
+                # peer or stall sync (tri-state contract, l2node.py)
+                continue
             bls_datas.append(
                 BlsData(cs.validator_address, cs.bls_signature)
             )

@@ -323,6 +323,8 @@ def g1_to_affine(p):
     x, y, z = p
     if z == 0:
         return None  # infinity
+    if z == 1:  # already affine (wire-decoded / native-returned points)
+        return (x, y)
     zi = pow(z, P - 2, P)
     zi2 = zi * zi % P
     return (x * zi2 % P, y * zi2 % P * zi % P)
@@ -422,6 +424,8 @@ def g2_to_affine(p):
     x, y, z = p
     if f2_is_zero(z):
         return None
+    if z == F2_ONE:  # already affine (wire-decoded / native-returned)
+        return (x, y)
     zi = f2_inv(z)
     zi2 = f2_sqr(zi)
     return (f2_mul(x, zi2), f2_mul(f2_mul(y, zi2), zi))
@@ -695,7 +699,7 @@ def iso11_map(x: int, y: int) -> tuple[int, int]:
     hx = _peval(h, x)
     if hx == 0:  # kernel point maps to infinity; cannot happen for SSWU output
         raise ValueError("point in isogeny kernel")
-    hx_i = pow(hx, P - 2, P)
+    hx_i = _fp_inv(hx)
     hx2_i = hx_i * hx_i % P
     X = (x + _peval(N2, x) * hx2_i) % P
     num = (_peval(N2p, x) * hx - 2 * _peval(N2, x) * _peval(hp, x)) % P
@@ -708,9 +712,39 @@ def _sgn0_be(x: int) -> int:
     return 1 if x > (P - 1) // 2 else 0
 
 
+# Native fast paths for the pow-heavy hash-to-curve field steps: a python
+# pow() here is ~300 us; the C library's Montgomery chain is ~20-40 us.
+# Pure-python fallbacks keep this module a complete standalone spec.
+
+
+def _fp_inv(v: int) -> int:
+    try:
+        from . import bls_native
+
+        out = bls_native.fp_inv48(v.to_bytes(48, "big"))
+        if out is not None:
+            return int.from_bytes(out, "big")
+    except Exception:
+        pass
+    return pow(v, P - 2, P)
+
+
 def _sqrt_fp(v: int) -> int | None:
+    try:
+        from . import bls_native
+
+        out = bls_native.fp_sqrt48(v.to_bytes(48, "big"))
+        if out is not None:
+            return int.from_bytes(out, "big") if out else None
+    except Exception:
+        pass
     s = pow(v, (P + 1) // 4, P)
     return s if s * s % P == v else None
+
+
+# constant inverses used by every SSWU evaluation (precomputed once)
+_A_ISO_INV: int = 0
+_ZA_ISO_INV: int = 0
 
 
 def sswu_iso(u: int) -> tuple[int, int]:
@@ -719,9 +753,9 @@ def sswu_iso(u: int) -> tuple[int, int]:
     u2 = u * u % P
     t1 = (Z * Z % P * u2 % P * u2 + Z * u2) % P  # Z^2 u^4 + Z u^2
     if t1 == 0:
-        x1 = B * pow(Z * A % P, P - 2, P) % P
+        x1 = B * _ZA_ISO_INV % P
     else:
-        x1 = (-B) * pow(A, P - 2, P) % P * (1 + pow(t1, P - 2, P)) % P
+        x1 = (-B) * _A_ISO_INV % P * (1 + _fp_inv(t1)) % P
     gx1 = (x1 * x1 % P * x1 + A * x1 + B) % P
     y1 = _sqrt_fp(gx1)
     if y1 is not None:
@@ -751,7 +785,28 @@ def map_to_curve_g1(fe48: bytes):
         raise ValueError("mapToCurve input not a canonical field element")
     x, y = sswu_iso(u)
     X, Y = iso11_map(x, y)
+    # cofactor clearing: the isogeny image is on E, so the native scalar
+    # mult applies directly (~40 us vs ~500 us of python jacobian steps)
+    try:
+        from . import bls_native
+
+        out = bls_native.g1_mul(
+            X.to_bytes(48, "big") + Y.to_bytes(48, "big"),
+            H_EFF_G1.to_bytes(32, "big"),
+        )
+        if out is not None:
+            if out == b"\x00" * 96:
+                return G1_INF
+            return (
+                int.from_bytes(out[:48], "big"),
+                int.from_bytes(out[48:], "big"),
+                1,
+            )
+    except Exception:
+        pass
     return g1_mul_raw((X, Y, 1), H_EFF_G1)
 
 
 _init_iso(ISO11_KERNEL)
+_A_ISO_INV = pow(A_ISO, P - 2, P)
+_ZA_ISO_INV = pow(Z_SSWU * A_ISO % P, P - 2, P)

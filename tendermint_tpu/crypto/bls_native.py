@@ -30,11 +30,17 @@ _loader = NativeLoader(
         "tmbls_g1_check",
         "tmbls_g2_check",
     ),
+    # late additions: a stale .so without these keeps its core functions
+    optional_funcs=(
+        "tmbls_fp_inv48",
+        "tmbls_fp_sqrt48",
+        "tmbls_keccak256",
+    ),
 )
 
 
-def native_lib() -> Optional[ctypes.CDLL]:
-    return _loader.get()
+def native_lib(build: bool = True) -> Optional[ctypes.CDLL]:
+    return _loader.get(build=build)
 
 
 def pairing_check(g1s: bytes, g2s: bytes, n: int) -> Optional[bool]:
@@ -87,6 +93,48 @@ def g2_msm(points: bytes, scalars: Optional[bytes], n: int) -> Optional[bytes]:
     out = ctypes.create_string_buffer(192)
     if lib.tmbls_g2_msm(out, points, scalars, n) < 0:
         raise ValueError("malformed G2 point in MSM")
+    return out.raw
+
+
+def fp_inv48(v48: bytes) -> Optional[bytes]:
+    """a^-1 mod p over 48-byte BE; inv(0) = 0 (matching pow(0, p-2, p));
+    None = no library; raises on non-canonical input."""
+    lib = native_lib()
+    if lib is None or not hasattr(lib, "tmbls_fp_inv48"):
+        return None
+    out = ctypes.create_string_buffer(48)
+    rc = lib.tmbls_fp_inv48(out, v48)
+    if rc < 0:
+        raise ValueError("fp_inv48: input not a canonical field element")
+    if rc == 0:
+        return b"\x00" * 48
+    return out.raw
+
+
+def fp_sqrt48(v48: bytes) -> Optional[bytes]:
+    """sqrt mod p over 48-byte BE; b"" = non-square; None = no library."""
+    lib = native_lib()
+    if lib is None or not hasattr(lib, "tmbls_fp_sqrt48"):
+        return None
+    out = ctypes.create_string_buffer(48)
+    rc = lib.tmbls_fp_sqrt48(out, v48)
+    if rc < 0:
+        raise ValueError("fp_sqrt48: input not a canonical field element")
+    if rc == 0:
+        return b""
+    return out.raw
+
+
+def keccak256(data: bytes) -> Optional[bytes]:
+    """build=False: hashing must never pay an inline g++ build — general
+    hash callers (ethutil, address derivation, CLI tools) get the fast
+    path only once the library is loaded (node/light preload, or any
+    prior BLS operation)."""
+    lib = native_lib(build=False)
+    if lib is None or not hasattr(lib, "tmbls_keccak256"):
+        return None
+    out = ctypes.create_string_buffer(32)
+    lib.tmbls_keccak256(out, data, len(data))
     return out.raw
 
 

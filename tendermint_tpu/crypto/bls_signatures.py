@@ -188,6 +188,17 @@ class PublicKey:
         return PublicKey(self.key, None)
 
 
+def _pub_wire(pub: PublicKey) -> bytes:
+    """Wire-format G2 bytes of a public key, cached on the instance —
+    registry keys are serialized for every native MSM/pairing call, and
+    the 4 int.to_bytes per call add up across 1k-member aggregates."""
+    w = pub.__dict__.get("_wire")
+    if w is None:
+        w = g2_to_bytes(pub.key)
+        object.__setattr__(pub, "_wire", w)
+    return w
+
+
 def generate_priv_key() -> int:
     return secrets.randbelow(c.R - 1) + 1
 
@@ -240,7 +251,7 @@ def _verify2(sig, message: bytes, pub: PublicKey, key_validation_mode: bool) -> 
 def aggregate_public_keys(pubs: list[PublicKey]) -> PublicKey:
     if native.native_lib() is not None and len(pubs) > 1:
         out = native.g2_msm(
-            b"".join(g2_to_bytes(pk.key) for pk in pubs), None, len(pubs)
+            b"".join(_pub_wire(pk) for pk in pubs), None, len(pubs)
         )
         return new_trusted_public_key(_g2_parse_unchecked(out))
     acc = c.G2_INF
@@ -336,7 +347,7 @@ def verify_batch_same_message(
         coeffs = [secrets.randbits(_BATCH_COEFF_BITS) | 1 for _ in idx]
         if native.native_lib() is not None:
             ks = b"".join(r.to_bytes(32, "big") for r in coeffs)
-            pk_bytes = b"".join(g2_to_bytes(pubs[i].key) for i in idx)
+            pk_bytes = b"".join(_pub_wire(pubs[i]) for i in idx)
             sig_bytes = b"".join(g1_to_bytes(sigs[i]) for i in idx)
             acc_pk = _g2_parse_unchecked(native.g2_msm(pk_bytes, ks, len(idx)))
             acc_sig = _g1_parse_unchecked(
