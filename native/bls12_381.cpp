@@ -336,7 +336,16 @@ static bool f12_is_one(const fp12 &a) {
 //   a*b = (E_a E_b + v O_a O_b) + w ((E_a+O_a)(E_b+O_b) - E_a E_b - O_a O_b)
 // 3 Fp6 muls (18 f2 muls) vs the 36 of schoolbook over w.
 static void f6_mul(fp2 o[3], const fp2 a[3], const fp2 b[3]);
-static void f6_mul_by_v(fp2 o[3], const fp2 a[3]);
+
+// v * (a0 + a1 v + a2 v^2) = XI*a2 + a0 v + a1 v^2
+static void f6_mul_by_v(fp2 o[3], const fp2 a[3]) {
+    fp2 t;
+    f2_mul_xi(t, a[2]);
+    fp2 a0 = a[0], a1 = a[1];
+    o[0] = t;
+    o[1] = a0;
+    o[2] = a1;
+}
 
 static void f12_mul(fp12 &o, const fp12 &a, const fp12 &b) {
     fp2 Ea[3] = {a.c[0], a.c[2], a.c[4]};
@@ -362,21 +371,9 @@ static void f12_mul(fp12 &o, const fp12 &a, const fp12 &b) {
     }
 }
 
-// dedicated squaring via the even/odd split: a = E(v) + w*O(v) with
-// E, O in Fp6 = Fp2[v]/(v^3 - XI) and v = w^2, so
+// dedicated squaring via the even/odd split: a = E(v) + w*O(v), so
 //   a^2 = (E^2 + v*O^2) + w*(2*E*O)
 // 2 Fp6 muls + 1 Fp6 "mul by v" vs the 36 Fp2 muls of schoolbook.
-static void f6_mul(fp2 o[3], const fp2 a[3], const fp2 b[3]);
-static void f6_mul_by_v(fp2 o[3], const fp2 a[3]) {
-    // v * (a0 + a1 v + a2 v^2) = XI*a2 + a0 v + a1 v^2
-    fp2 t;
-    f2_mul_xi(t, a[2]);
-    fp2 a0 = a[0], a1 = a[1];
-    o[0] = t;
-    o[1] = a0;
-    o[2] = a1;
-}
-
 static void f12_sqr(fp12 &o, const fp12 &a) {
     // complex squaring: with t = (E+O)*(E+v*O),
     //   E^2 + v*O^2 = t - EO - v*EO   and   2*E*O = EO + EO

@@ -22,10 +22,40 @@ import threading
 from typing import Optional, Sequence
 
 
+_host_tag_cache: Optional[str] = None
+
+
+def _host_tag() -> str:
+    """ISA fingerprint folded into the build hash: -march=native emits
+    host-specific instructions, so a .so built on one CPU must not be
+    dlopen'd from a shared checkout (NFS home, multi-node testnet dir)
+    by a host with different CPU features — that's a SIGILL, not a
+    catchable exception."""
+    global _host_tag_cache
+    if _host_tag_cache is None:
+        feat = ""
+        try:
+            with open("/proc/cpuinfo", "r") as f:
+                for line in f:
+                    if line.startswith(("flags", "Features")):
+                        feat = line
+                        break
+        except OSError:
+            pass
+        if not feat:
+            import platform
+
+            feat = platform.machine() + platform.processor()
+        _host_tag_cache = hashlib.sha256(feat.encode()).hexdigest()[:16]
+    return _host_tag_cache
+
+
 def _src_hash(src: str) -> Optional[str]:
     try:
         with open(src, "rb") as f:
-            return hashlib.sha256(f.read()).hexdigest()
+            return (
+                hashlib.sha256(f.read()).hexdigest() + ":" + _host_tag()
+            )
     except OSError:
         return None
 

@@ -149,7 +149,8 @@ class _TableCache:
         cur = 0 if self.tables is None else self.tables.shape[0]
         if rows <= cur:
             return
-        tables = jnp.zeros((rows, *self._entry_shape), dtype=jnp.int32)
+        # canonical uint8 limbs (neg_pubkey_table): 128 KiB/key big tier
+        tables = jnp.zeros((rows, *self._entry_shape), dtype=jnp.uint8)
         valid = jnp.zeros(rows, dtype=bool)
         if cur:
             tables = tables.at[:cur].set(self.tables)

@@ -40,7 +40,16 @@ _loader = NativeLoader(
 
 
 def native_lib(build: bool = True) -> Optional[ctypes.CDLL]:
-    return _loader.get(build=build)
+    lib = _loader.get(build=build)
+    if lib is not None and not getattr(lib, "_tm_argtypes_set", False):
+        # declare size_t counts explicitly — default ctypes int conversion
+        # truncates through c_int, corrupting lengths >= 2^31
+        cp, sz = ctypes.c_char_p, ctypes.c_size_t
+        lib.tmbls_pairing_check.argtypes = [cp, cp, sz]
+        lib.tmbls_g1_msm.argtypes = [cp, cp, cp, sz]
+        lib.tmbls_g2_msm.argtypes = [cp, cp, cp, sz]
+        lib._tm_argtypes_set = True
+    return lib
 
 
 def pairing_check(g1s: bytes, g2s: bytes, n: int) -> Optional[bool]:
@@ -133,8 +142,13 @@ def keccak256(data: bytes) -> Optional[bytes]:
     lib = native_lib(build=False)
     if lib is None or not hasattr(lib, "tmbls_keccak256"):
         return None
+    fn = lib.tmbls_keccak256
+    if fn.argtypes is None:
+        # without argtypes ctypes would truncate len(data) through c_int,
+        # silently corrupting the length for >= 2 GiB inputs
+        fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
     out = ctypes.create_string_buffer(32)
-    lib.tmbls_keccak256(out, data, len(data))
+    fn(out, data, len(data))
     return out.raw
 
 

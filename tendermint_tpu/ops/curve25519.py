@@ -249,10 +249,14 @@ def window_table(p: jnp.ndarray) -> jnp.ndarray:
 
 
 def _select_entry(table: jnp.ndarray, dig: jnp.ndarray) -> jnp.ndarray:
-    """table: [..., 16, 4, 32] cached; dig: [...] in [0, 16)."""
+    """table: [..., 16, 4, 32] cached; dig: [...] in [0, 16).
+
+    Accepts narrow-dtype tables (the persistent caches store canonical
+    uint8 limbs — 4x less gather traffic / cache memory); the widen back
+    to int32 fuses into the consuming add."""
     return jnp.take_along_axis(
         table, dig[..., None, None, None], axis=-3
-    ).squeeze(-3)
+    ).squeeze(-3).astype(jnp.int32)
 
 
 # --- fixed-base table (basepoint) -----------------------------------------
@@ -276,22 +280,32 @@ def _base_table() -> np.ndarray:
             rows.append([from_host_point_cached(p) for p in row])
             for _ in range(8):
                 base = host.point_double(base)
-        _BASE_TABLE_NP = np.asarray(rows, dtype=np.int32)
+        # canonical host values < 256: uint8 storage (1 MiB, not 4)
+        _BASE_TABLE_NP = np.asarray(rows, dtype=np.uint8)
     return _BASE_TABLE_NP
 
 
 def scalar_mult_base(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
     """[s]B for s: [..., 32] u8 (little-endian, < 2^256). No doublings:
-    sum over the 32 byte-digit rows of the precomputed basepoint table."""
+    sum over the 32 byte-digit rows of the precomputed basepoint table.
+
+    The host-built table limbs are canonical (< 256), so it ships to the
+    device as uint8 (1 MiB instead of 4); the loop accumulator round-trips
+    through int16 at iteration boundaries (loose limbs < 2^9) — both
+    bit-exact, both halving the traffic the executor bills per iteration
+    (PERF_ANALYSIS.md)."""
     digs = scalar_bytes.astype(jnp.int32)  # [..., 32] LSB-first bytes
-    table = jnp.asarray(_base_table())  # [32, 256, 4, 32] cached
+    table = jnp.asarray(_base_table())  # [32, 256, 4, 32] uint8
 
     def body(i, acc):
         row = jax.lax.dynamic_index_in_dim(table, i, keepdims=False)
-        entry = jnp.take(row, digs[..., i], axis=0)  # [..., 4, 32]
-        return add_cached(acc, entry)
+        entry = jnp.take(row, digs[..., i], axis=0)  # [..., 4, 32] u8
+        return add_cached(
+            acc.astype(jnp.int32), entry.astype(jnp.int32)
+        ).astype(jnp.int16)
 
-    return jax.lax.fori_loop(0, 32, body, identity(digs.shape[:-1]))
+    init = identity(digs.shape[:-1]).astype(jnp.int16)
+    return jax.lax.fori_loop(0, 32, body, init).astype(jnp.int32)
 
 
 def big_window_table(p: jnp.ndarray) -> jnp.ndarray:
@@ -365,14 +379,15 @@ def scalar_mult_var_bigcache(
     kernel that keeps the window tables in VMEM."""
     digs = nibbles(scalar_bytes)  # [B, 64] LSB-first
 
-    def body(i, acc):
+    def body(i, acc16):
         row = jax.lax.dynamic_index_in_dim(
             tables_cache, i, axis=1, keepdims=False
         )  # [cap, 16, 4, 32]
-        ent = row[idx, digs[..., i]]  # [B, 4, 32]
-        return add_cached(acc, ent)
+        ent = row[idx, digs[..., i]].astype(jnp.int32)  # [B, 4, 32]
+        return add_cached(acc16.astype(jnp.int32), ent).astype(jnp.int16)
 
-    return jax.lax.fori_loop(0, 64, body, identity(digs.shape[:-1]))
+    init = identity(digs.shape[:-1]).astype(jnp.int16)
+    return jax.lax.fori_loop(0, 64, body, init).astype(jnp.int32)
 
 
 def scalar_mult_var_bigcache_mxu(
@@ -386,11 +401,9 @@ def scalar_mult_var_bigcache_mxu(
     Per window w, the selected entry is
         onehot[b, idx[b]*16 + digs[b,w]] @ tables[:, w].reshape(cap*16, 128)
     i.e. a [B, cap*16] x [cap*16, 128] f32 matmul whose left operand has
-    one 1 per row. Exactness: table limbs satisfy the loose invariant
-    limbs in [0, 2^9) (field25519.py — device-built tables come out of
-    fe.mul un-canonicalized), and any value < 2^24 is exact in f32; a
-    narrower dtype (bf16/int8) would NOT be safe without canonicalizing
-    the tables first.
+    one 1 per row. Exactness: persistent-cache tables are canonical uint8
+    limbs (< 256) and in-batch tables are loose (< 2^9) — either way any
+    value < 2^24 is exact in f32; bf16 would NOT be safe.
     On MXU silicon this turns the generalized gather — the measured
     bottleneck of the fori_loop path — into systolic-array work the chip
     is built for; on this harness's executor (~0.1 TFLOP/s effective) the
@@ -430,12 +443,15 @@ def scalar_mult_var_table(
     digs = nibbles(scalar_bytes)  # [..., 64]
     batch_shape = digs.shape[:-1]
 
-    def body(i, acc):
-        acc = double(double(double(double(acc))))
+    def body(i, acc16):
+        acc = double(double(double(double(acc16.astype(jnp.int32)))))
         dig = digs[..., 63 - i]  # MSB-first
-        return add_cached(acc, _select_entry(table, dig))
+        # int16 at the loop boundary: loose limbs < 2^9 make the
+        # round-trip exact, and halve the materialized carry traffic
+        return add_cached(acc, _select_entry(table, dig)).astype(jnp.int16)
 
-    return jax.lax.fori_loop(0, 64, body, identity(batch_shape))
+    init = identity(batch_shape).astype(jnp.int16)
+    return jax.lax.fori_loop(0, 64, body, init).astype(jnp.int32)
 
 
 def scalar_mult_var(scalar_bytes: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:

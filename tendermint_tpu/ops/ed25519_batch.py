@@ -50,11 +50,17 @@ def verify_prehashed(
 def neg_pubkey_table(pubkeys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Build cached window tables for -A per pubkey.
 
-    pubkeys: [N, 32] u8 -> (tables [N, 16, 4, 32] i32, valid [N] bool).
-    One-time per validator; the verify path then runs table-only.
-    """
+    pubkeys: [N, 32] u8 -> (tables [N, 16, 4, 32] u8, valid [N] bool).
+    One-time per validator; the verify path then runs table-only. Entries
+    are canonicalized so the persistent cache stores uint8 limbs — 4x
+    less cache memory and gather traffic than loose int32, bit-exact
+    (canonicalization is value-preserving mod p; the group ops accept
+    any loose input)."""
     a_point, a_valid = curve.decompress(pubkeys)
-    return curve.window_table(curve.neg(a_point)), a_valid
+    table = curve.window_table(curve.neg(a_point))
+    from . import field25519 as fe
+
+    return fe.canonical(table).astype(jnp.uint8), a_valid
 
 
 def verify_prehashed_table(
@@ -77,13 +83,17 @@ def neg_pubkey_bigtable(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fixed-window tables for -A per pubkey: doubling-free verification.
 
-    pubkeys: [N, 32] u8 -> (tables [N, 64, 16, 4, 32] i32, valid [N] bool).
-    512 KiB per key; built once per validator (SURVEY.md §3.3 — the same
-    validators sign every height), after which each verify is 128 cached
-    adds and zero doublings.
+    pubkeys: [N, 32] u8 -> (tables [N, 64, 16, 4, 32] u8, valid [N] bool).
+    128 KiB per key (canonical uint8 limbs — see neg_pubkey_table); built
+    once per validator (SURVEY.md §3.3 — the same validators sign every
+    height), after which each verify is 128 cached adds and zero
+    doublings.
     """
     a_point, a_valid = curve.decompress(pubkeys)
-    return curve.big_window_table(curve.neg(a_point)), a_valid
+    table = curve.big_window_table(curve.neg(a_point))
+    from . import field25519 as fe
+
+    return fe.canonical(table).astype(jnp.uint8), a_valid
 
 
 def verify_prehashed_bigcache(
