@@ -53,9 +53,7 @@ def _host_tag() -> str:
 def _src_hash(src: str) -> Optional[str]:
     try:
         with open(src, "rb") as f:
-            return (
-                hashlib.sha256(f.read()).hexdigest() + ":" + _host_tag()
-            )
+            return hashlib.sha256(f.read()).hexdigest()
     except OSError:
         return None
 
@@ -69,11 +67,19 @@ def _stored_hash(so_path: str) -> Optional[str]:
 
 
 def build_or_load(so_name: str, src_name: str, timeout: int = 180) -> Optional[ctypes.CDLL]:
-    """Compile native/<src_name> into tendermint_tpu/<so_name> if the
-    source hash differs from the recorded one, then dlopen it."""
+    """Compile native/<src_name> into tendermint_tpu/<base>.<hosttag>.so
+    if the source hash differs from the recorded one, then dlopen it.
+
+    The host-ISA tag lives in the FILENAME, making the artifact per-host:
+    on a shared checkout (NFS home, multi-node testnet dir) two
+    different-CPU hosts each keep their own .so instead of clobbering a
+    shared one — and a host can never dlopen another host's
+    -march=native machine code (SIGILL, not a catchable error). A .so
+    without this host's tag is never loaded, even as a fallback."""
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     repo_root = os.path.dirname(pkg_root)
-    so_path = os.path.join(pkg_root, so_name)
+    base, ext = os.path.splitext(so_name)
+    so_path = os.path.join(pkg_root, f"{base}.{_host_tag()}{ext}")
     src = os.path.join(repo_root, "native", src_name)
 
     want = _src_hash(src)

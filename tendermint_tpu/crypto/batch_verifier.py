@@ -19,9 +19,10 @@ Responsibilities:
   pubkeys every height (2N sigs/height from one validator set — SURVEY.md
   §3.3), so each pubkey's decompressed negated table is built once and kept
   device-resident. Small (latency-sensitive, vote-sized) batches use radix-16
-  window tables (8 KiB/key, cheap to build inline); bulk batches (blocksync/
-  light replay) use doubling-free fixed-window tables (512 KiB/key, ~64x the
-  build cost — amortized over thousands of reuses, 2.5x faster to verify);
+  window tables (2 KiB/key as canonical uint8 limbs, cheap to build inline);
+  bulk batches (blocksync/light replay) use doubling-free fixed-window tables
+  (128 KiB/key, ~64x the build cost — amortized over thousands of reuses,
+  2.5x faster to verify);
 - mixed key types: non-ed25519 rows (secp256k1/sr25519) partition to host;
 - optional mesh sharding: with a `jax.sharding.Mesh`, the batch axis is
   sharded across devices (`NamedSharding`) so one commit's votes spread over
@@ -47,9 +48,10 @@ from .ed25519 import L, challenge
 BUCKETS = (8, 32, 128, 512, 2048, 8192)
 
 # max rows of the device-resident table caches. Small tier: radix-16 window
-# tables, 8 KiB/key. Big tier: fixed-window tables, 512 KiB/key (4096 keys
-# = 2 GiB worst case; both stores allocate lazily and grow in power-of-two
-# row counts, so the cap only bounds the worst case).
+# tables, 2 KiB/key. Big tier: fixed-window tables, 128 KiB/key as canonical
+# uint8 limbs (4096 keys = 512 MiB worst case; both stores allocate lazily
+# and grow in power-of-two row counts, so the cap only bounds the worst
+# case).
 TABLE_CACHE_CAPACITY = 4096
 
 # batches >= this bucket size use the big (doubling-free) tier; smaller
@@ -100,7 +102,7 @@ def _use_mxu_gather() -> bool:
 
 def _verify_cached_big(tables, tvalid, idx, rb, sb, kb, s_ok):
     """Big tier: doubling-free fixed-window verify against the shared
-    cache (the kernel gathers per-window slices internally so the 512 KiB
+    cache (the kernel gathers per-window slices internally so the 128 KiB
     per-key tables are never materialized per batch row)."""
     tv = jnp.take(tvalid, jnp.maximum(idx, 0), axis=0) & (idx >= 0)
     return ed25519_batch.verify_prehashed_bigcache(
@@ -179,7 +181,7 @@ class _TableCache:
                     self.valid = jnp.zeros_like(self.valid)
                 new = uniq
             self._grow(len(self._idx) + len(new))
-            # chunked builds: big-tier tables are 512 KiB each, so building
+            # chunked builds: big-tier tables are 128 KiB each, so building
             # thousands of keys at once would transiently hold GiBs
             for lo in range(0, len(new), 512):
                 chunk = new[lo : lo + 512]

@@ -310,7 +310,8 @@ def scalar_mult_base(scalar_bytes: jnp.ndarray) -> jnp.ndarray:
 
 def big_window_table(p: jnp.ndarray) -> jnp.ndarray:
     """Per-element fixed-window table T[i, j] = cached([j * 16^i]P):
-    [..., 64, 16, 4, 32] int32 (512 KiB per element).
+    [..., 64, 16, 4, 32] int32 (512 KiB per element in loose form; the
+    persistent caches store it canonicalized as uint8, 128 KiB/key).
 
     The doubling-free analogue of `_base_table` for a *variable* base: with
     it, [k]P is 64 cached adds and zero doublings (`scalar_mult_var_bigtable`)
@@ -366,7 +367,7 @@ def scalar_mult_var_bigcache(
     """[s]·T[idx] against a shared device-resident table cache.
 
     Gathers one window-row slice per iteration ([cap, 16, 4, 32] sliced,
-    then a [B]-gather of the selected digit entries) so the full 512 KiB
+    then a [B]-gather of the selected digit entries) so the full per-key
     per-key tables are never materialized per batch element.
 
     Measured dead end (r3, keep for the record): splitting the 64

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from . import curve25519 as curve
+from . import field25519 as fe
 
 
 def verify_prehashed(
@@ -58,9 +59,7 @@ def neg_pubkey_table(pubkeys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     any loose input)."""
     a_point, a_valid = curve.decompress(pubkeys)
     table = curve.window_table(curve.neg(a_point))
-    from . import field25519 as fe
-
-    return fe.canonical(table).astype(jnp.uint8), a_valid
+    return fe.to_bytes(table), a_valid
 
 
 def verify_prehashed_table(
@@ -91,9 +90,7 @@ def neg_pubkey_bigtable(
     """
     a_point, a_valid = curve.decompress(pubkeys)
     table = curve.big_window_table(curve.neg(a_point))
-    from . import field25519 as fe
-
-    return fe.canonical(table).astype(jnp.uint8), a_valid
+    return fe.to_bytes(table), a_valid
 
 
 def verify_prehashed_bigcache(
