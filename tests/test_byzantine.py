@@ -1,0 +1,303 @@
+"""Byzantine-validator consensus tests (VERDICT r3 missing #1).
+
+Reconstruction of the reference's dead byzantine suite
+(consensus/byzantine_test.go in /root/reference; SURVEY.md §4.1 makes
+rebuilding it this repo's deliverable): a 4-validator in-proc net where
+the 4th validator is actively malicious — it never runs the honest state
+machine, and a driver hooked into the honest nodes' gossip injects
+signed equivocations at the live (height, round). Each scenario asserts
+the three byzantine-fault-tolerance properties:
+
+  safety   — honest nodes never commit different blocks at a height
+  evidence — the equivocation is captured, pooled, proposed, and lands
+             in a committed block as DuplicateVoteEvidence
+  liveness — the chain keeps advancing with 3/4 honest power
+"""
+
+import asyncio
+import time
+
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus.messages import ProposalMessage, VoteMessage
+from tendermint_tpu.consensus.state_machine import (
+    ConsensusConfig,
+    ConsensusState,
+)
+from tendermint_tpu.evidence import EvidencePool
+from tendermint_tpu.l2node.mock import MockL2Node
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.store.kv import MemKV
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.types.vote import Vote, VoteType
+
+from .helpers import CHAIN_ID, make_genesis, make_validators
+
+BYZ = 3  # validator index of the byzantine actor
+
+
+def _make_honest_node(pv, genesis):
+    """Full node with an evidence pool wired through the executor, so
+    captured equivocations flow into proposed blocks."""
+    l2 = MockL2Node()
+    app = KVStoreApplication()
+    state = State.from_genesis(genesis)
+    state_store = StateStore(MemKV())
+    state_store.bootstrap(state)
+    block_store = BlockStore(MemKV())
+    pool = EvidencePool(MemKV(), state_store, block_store)
+    executor = BlockExecutor(
+        state_store, block_store, LocalClient(app), l2, evidence_pool=pool
+    )
+    cs = ConsensusState(
+        ConsensusConfig.test_config(),
+        state,
+        executor,
+        block_store,
+        l2,
+        priv_validator=pv,
+        evidence_pool=pool,
+    )
+    return cs, pool, block_store
+
+
+def _byz_vote(pv, vtype, height, round_, block_id):
+    v = Vote(
+        type=vtype,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp_ns=time.time_ns(),
+        validator_address=pv.get_pub_key().address(),
+        validator_index=BYZ,
+    )
+    pv.sign_vote(CHAIN_ID, v)
+    return v
+
+
+def _fake_block_id():
+    h = b"\xbb" * 32
+    return BlockID(hash=h, part_set_header=PartSetHeader(1, h))
+
+
+def _wire(css, observer=None):
+    """Full-mesh gossip of self-produced messages; `observer(i, msg)`
+    sees every broadcast (the byzantine driver's tap)."""
+    for i, n in enumerate(css):
+
+        def hook(msg, i=i):
+            for j, other in enumerate(css):
+                if j != i:
+                    other.peer_msg_queue.put_nowait((msg, f"node{i}"))
+            if observer is not None:
+                observer(i, msg)
+
+        n.broadcast_hook = hook
+
+
+def _inject(cs, vote):
+    cs.peer_msg_queue.put_nowait((VoteMessage(vote), "byzantine"))
+
+
+def _assert_no_fork(css, up_to_height):
+    for h in range(1, up_to_height + 1):
+        hashes = {
+            cs.block_store.load_block(h).hash()
+            for cs in css
+            if cs.block_store.load_block(h) is not None
+        }
+        assert len(hashes) <= 1, f"honest nodes forked at height {h}"
+
+
+def _committed_byz_evidence(block_store, byz_addr, up_to_height):
+    for h in range(2, up_to_height + 1):
+        blk = block_store.load_block(h)
+        if blk is None:
+            continue
+        for ev in blk.evidence:
+            if (
+                isinstance(ev, DuplicateVoteEvidence)
+                and ev.vote_a.validator_address == byz_addr
+            ):
+                return ev
+    return None
+
+
+def test_equivocating_precommits_yield_committed_evidence():
+    """The byzantine validator precommits two different blocks at the
+    same (height, round), relayed to every honest node. Safety holds,
+    the duplicate-vote evidence commits, and the chain keeps moving
+    (reference byzantine_test.go's double-sign shape)."""
+    vs, pvs = make_validators(4)
+    genesis = make_genesis(vs)
+    byz_pv = pvs[BYZ]
+    byz_addr = byz_pv.get_pub_key().address()
+
+    nodes = [_make_honest_node(pv, genesis) for pv in pvs[:3]]
+    css = [n[0] for n in nodes]
+    injected: set = set()
+
+    def byz_driver(i, msg):
+        # fire once per height: when node0 precommits a real block, the
+        # byzantine validator "precommits" both that block and a fake
+        # one at the same (h, r) to the whole net
+        if i != 0 or not isinstance(msg, VoteMessage):
+            return
+        v = msg.vote
+        if v.type != VoteType.PRECOMMIT or v.is_nil():
+            return
+        key = (v.height, v.round)
+        if key in injected or len(injected) >= 2:
+            return
+        injected.add(key)
+        va = _byz_vote(byz_pv, VoteType.PRECOMMIT, v.height, v.round, v.block_id)
+        vb = _byz_vote(
+            byz_pv, VoteType.PRECOMMIT, v.height, v.round, _fake_block_id()
+        )
+        for cs in css:
+            _inject(cs, va)
+            _inject(cs, vb)
+
+    _wire(css, observer=byz_driver)
+
+    async def run():
+        for cs in css:
+            await cs.start()
+        await asyncio.gather(*(cs.wait_for_height(5, timeout=90) for cs in css))
+        for cs in css:
+            await cs.stop()
+
+    asyncio.run(run())
+    assert injected, "byzantine driver never fired"
+    _assert_no_fork(css, 5)
+    ev = _committed_byz_evidence(css[0].block_store, byz_addr, 5)
+    assert ev is not None, "byzantine equivocation never committed as evidence"
+    assert ev.vote_a.block_id != ev.vote_b.block_id
+    for cs in css:
+        assert cs.state.last_block_height >= 5, "liveness lost"
+
+
+def test_split_prevotes_no_fork():
+    """Conflicting prevotes targeted at different peers (the classic
+    split-vote attack): the real proposal hash goes to nodes {0,1}, a
+    fabricated hash to nodes {1,2}. Node 1 sees both and captures the
+    equivocation; no honest pair ever commits different blocks."""
+    vs, pvs = make_validators(4)
+    genesis = make_genesis(vs)
+    byz_pv = pvs[BYZ]
+    byz_addr = byz_pv.get_pub_key().address()
+
+    nodes = [_make_honest_node(pv, genesis) for pv in pvs[:3]]
+    css = [n[0] for n in nodes]
+    injected: set = set()
+
+    def byz_driver(i, msg):
+        if not isinstance(msg, ProposalMessage):
+            return
+        p = msg.proposal
+        key = (p.height, p.round)
+        if key in injected or len(injected) >= 3:
+            return
+        injected.add(key)
+        real = _byz_vote(
+            byz_pv, VoteType.PREVOTE, p.height, p.round, p.block_id
+        )
+        fake = _byz_vote(
+            byz_pv, VoteType.PREVOTE, p.height, p.round, _fake_block_id()
+        )
+        _inject(css[0], real)
+        _inject(css[1], real)
+        _inject(css[1], fake)
+        _inject(css[2], fake)
+
+    _wire(css, observer=byz_driver)
+
+    async def run():
+        for cs in css:
+            await cs.start()
+        await asyncio.gather(*(cs.wait_for_height(4, timeout=90) for cs in css))
+        for cs in css:
+            await cs.stop()
+
+    asyncio.run(run())
+    assert injected, "byzantine driver never fired"
+    _assert_no_fork(css, 4)
+    for cs in css:
+        assert cs.state.last_block_height >= 4, "liveness lost"
+    # node 1 received both conflicting prevotes: the equivocation must be
+    # captured and eventually committed by some honest proposer
+    ev = _committed_byz_evidence(css[1].block_store, byz_addr, 4)
+    assert ev is not None, "split prevotes never captured as evidence"
+    assert ev.vote_a.type == VoteType.PREVOTE
+
+
+def test_byzantine_proposer_rounds_skipped():
+    """The byzantine validator is silent whenever it is the proposer
+    (forcing round changes) while still equivocating precommits in other
+    rounds. The honest majority must ride through its proposer slots:
+    liveness and agreement hold across a window that includes byzantine
+    proposer rounds."""
+    vs, pvs = make_validators(4)
+    genesis = make_genesis(vs)
+    byz_pv = pvs[BYZ]
+    byz_addr = byz_pv.get_pub_key().address()
+
+    nodes = [_make_honest_node(pv, genesis) for pv in pvs[:3]]
+    css = [n[0] for n in nodes]
+    injected: set = set()
+
+    def byz_driver(i, msg):
+        if i != 0 or not isinstance(msg, VoteMessage):
+            return
+        v = msg.vote
+        if v.type != VoteType.PRECOMMIT or v.is_nil():
+            return
+        key = v.height
+        if key in injected:
+            return
+        injected.add(key)
+        va = _byz_vote(byz_pv, VoteType.PRECOMMIT, v.height, v.round, v.block_id)
+        vb = _byz_vote(
+            byz_pv, VoteType.PRECOMMIT, v.height, v.round, _fake_block_id()
+        )
+        for cs in css:
+            _inject(cs, va)
+            _inject(cs, vb)
+
+    _wire(css, observer=byz_driver)
+
+    async def run():
+        for cs in css:
+            await cs.start()
+        # 6 heights with round-robin proposers guarantees at least one
+        # byzantine proposer slot (4 validators)
+        await asyncio.gather(*(cs.wait_for_height(6, timeout=120) for cs in css))
+        for cs in css:
+            await cs.stop()
+
+    asyncio.run(run())
+    _assert_no_fork(css, 6)
+    for cs in css:
+        assert cs.state.last_block_height >= 6, "liveness lost"
+    # at least one commit must carry a non-zero round (the byzantine
+    # proposer's slot timed out and the net recovered in a later round)
+    rounds = []
+    for h in range(1, 7):
+        blk = css[0].block_store.load_block(h + 1)
+        if blk is not None and blk.last_commit is not None:
+            rounds.append(blk.last_commit.round)
+        else:
+            sc = css[0].block_store.load_seen_commit(h)
+            if sc is not None:
+                rounds.append(sc.round)
+    assert any(r > 0 for r in rounds), (
+        f"no round ever advanced past 0 ({rounds}) — byzantine proposer "
+        "slots were never exercised"
+    )
+    ev = _committed_byz_evidence(css[0].block_store, byz_addr, 6)
+    assert ev is not None, "equivocation evidence missing"
