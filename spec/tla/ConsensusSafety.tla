@@ -10,8 +10,11 @@
 (* model (timeouts and the proposer function are abstracted away — they    *)
 (* affect liveness, not safety).                                           *)
 (*                                                                         *)
-(* Status: syntax-complete TLA+, NOT model-checked in this build           *)
-(* environment (no TLC/Apalache in the image — see spec/tla/README.md).    *)
+(* Status: machine-checked in CI. tests/test_model_safety.py explores     *)
+(* the full reachable space of the 4-validator/3-round/2-value instance   *)
+(* with an explicit-state BFS (no TLC/Apalache in the image) and asserts  *)
+(* Agreement; the NoLaterVotes guard below was ADDED because that check   *)
+(* found a genuine violation in the module as first written.              *)
 (***************************************************************************)
 
 EXTENDS Integers, FiniteSets
@@ -55,9 +58,23 @@ PolkaAt(r, val) ==
 (* by overwriting).                                                        *)
 (***************************************************************************)
 
+(* Round monotonicity: an honest validator participates in increasing   *)
+(* rounds (state_machine.py advances rs.round monotonically within a    *)
+(* height).  This is a SAFETY-relevant guard, not a liveness detail:    *)
+(* without it the r4 machine check (tests/test_model_safety.py) finds a *)
+(* genuine Agreement violation — an honest validator prevotes val B at  *)
+(* round 1 BEFORE acting in round 0, then locks A at round 0; the       *)
+(* round-1 polka for B later satisfies the unlock guard of a second     *)
+(* A-locked validator, and B reaches quorum at round 2 while A's        *)
+(* round-0 decision stands.                                             *)
+NoLaterVotes(v, r) ==
+  \A r2 \in ROUNDS : r2 > r =>
+    prevotes[r2][v] = NoVote /\ precommits[r2][v] = NoVote
+
 HonestPrevote(v, r, val) ==
   /\ v \in Honest
   /\ prevotes[r][v] = NoVote
+  /\ NoLaterVotes(v, r)
   /\ \/ locked[v].val = Nil
      \/ locked[v].val = val
      \/ \E pr \in ROUNDS :
@@ -68,6 +85,7 @@ HonestPrevote(v, r, val) ==
 HonestPrecommit(v, r, val) ==
   /\ v \in Honest
   /\ precommits[r][v] = NoVote
+  /\ NoLaterVotes(v, r)
   /\ val \in VALUES => PolkaAt(r, val)
   /\ precommits' = [precommits EXCEPT ![r][v] = val]
   /\ locked' =

@@ -81,7 +81,27 @@ def main() -> None:
     t5, t50 = _best(chain(5), ar), _best(chain(50), ar)
     out["marginal_fe_mul_in_graph_ms"] = round((t50 - t5) / 45 * 1e3, 2)
 
-    # 5. generic verifier batch scaling (linear => volume-bound,
+    # 5. per-loop-iteration cost with a table gather: the 64-iteration
+    #    window ladder, net of dispatch overhead — what fori_loop bodies
+    #    that gather actually pay (the verifier's dominant term)
+    from tendermint_tpu.ops import curve25519 as curve
+
+    rng2 = np.random.default_rng(2)
+    kb = jnp.asarray(
+        rng2.integers(0, 256, (8192, 32)).astype(np.uint8)
+    )
+    pkb = np.tile(
+        np.frombuffer(hosted.PrivKey.generate().public_key().data, np.uint8),
+        (8192, 1),
+    )
+    apt, _ = jax.jit(curve.decompress)(jnp.asarray(pkb))
+    tab = jax.jit(curve.window_table)(curve.neg(apt))
+    dt = _best(jax.jit(curve.scalar_mult_var_table), kb, tab)
+    net = max(0.0, dt - out["call_overhead_ms"] / 1e3)
+    out["window_ladder_64iter_net_ms"] = round(net * 1e3, 1)
+    out["loop_iter_with_gather_ms"] = round(net / 64 * 1e3, 2)
+
+    # 6. generic verifier batch scaling (linear => volume-bound,
     #    flat => dispatch-bound)
     p1 = hosted.PrivKey.generate().public_key()
     full = jax.jit(ed.verify_prehashed)
