@@ -13,11 +13,25 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..libs.flowrate import Monitor
 from ..libs.log import Logger, nop_logger
 from ..types.block import Block, Commit
 
 REQUEST_WINDOW = 40  # max heights in flight (reference maxPendingRequests)
 REQUEST_TIMEOUT = 8.0
+# minimum sustained recv rate before a peer with pending requests is
+# banned (reference blocksync/pool.go minRecvRate: 7680 B/s) — a
+# slow-but-alive peer must not throttle sync indefinitely
+MIN_RECV_RATE = 7680.0
+# reference bpPeer uses flow.New(time.Second, 40*time.Second): the long
+# window keeps multi-second block transfers from decaying a healthy
+# peer's rate below the ban threshold between deliveries
+RATE_SAMPLE = 1.0
+RATE_WINDOW = 40.0
+
+
+def _peer_monitor() -> Monitor:
+    return Monitor(sample_period=RATE_SAMPLE, window=RATE_WINDOW)
 
 
 @dataclass
@@ -27,6 +41,7 @@ class _PoolPeer:
     height: int
     pending: set[int] = field(default_factory=set)
     timeouts: int = 0
+    recv_monitor: Monitor = field(default_factory=_peer_monitor)
 
 
 @dataclass
@@ -91,8 +106,24 @@ class BlockPool:
 
     # --- request scheduling ----------------------------------------------
 
+    def check_peer_rates(self) -> None:
+        """Ban peers with pending requests whose sustained recv rate fell
+        below MIN_RECV_RATE (reference removeTimedoutPeers, pool.go:522).
+        cur_rate stays exactly 0.0 until the first block arrives, so a
+        never-sent peer is left to the request-timeout path."""
+        for p in list(self._peers.values()):
+            if not p.pending:
+                continue
+            rate = p.recv_monitor.status().cur_rate
+            if rate != 0.0 and rate < MIN_RECV_RATE:
+                self._on_peer_error(
+                    p.peer_id, "peer is not sending us data fast enough"
+                )
+                self.remove_peer(p.peer_id)
+
     def make_requests(self) -> None:
         """Ensure up to REQUEST_WINDOW requesters exist and are assigned."""
+        self.check_peer_rates()
         target = self.max_peer_height()
         for h in range(self.height, min(self.height + REQUEST_WINDOW, target + 1)):
             if h not in self._requesters:
@@ -111,6 +142,11 @@ class BlockPool:
             if self._send_request(peer.peer_id, r.height):
                 r.peer_id = peer.peer_id
                 r.requested_at = now
+                if not peer.pending:
+                    # fresh busy period: restart the rate window so a
+                    # stale decayed rate from an idle stretch can't
+                    # instantly trip the min-rate ban
+                    peer.recv_monitor = _peer_monitor()
                 peer.pending.add(r.height)
 
     def _pick_peer(self, height: int) -> Optional[_PoolPeer]:
@@ -134,7 +170,7 @@ class BlockPool:
 
     # --- block ingestion --------------------------------------------------
 
-    def add_block(self, peer_id: str, block: Block) -> bool:
+    def add_block(self, peer_id: str, block: Block, size: int = 0) -> bool:
         h = block.header.height
         r = self._requesters.get(h)
         if r is None or r.block is not None:
@@ -146,6 +182,7 @@ class BlockPool:
         p = self._peers.get(peer_id)
         if p is not None:
             p.pending.discard(h)
+            p.recv_monitor.update(size)  # peer-quality rate accounting
         return True
 
     def no_block(self, peer_id: str, height: int) -> None:

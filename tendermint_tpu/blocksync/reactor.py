@@ -190,7 +190,7 @@ class BlocksyncReactor(Reactor):
                     peer, f"undecodable block: {e}"
                 )
                 return
-            self.pool.add_block(peer.id, block)
+            self.pool.add_block(peer.id, block, size=len(msg))
         elif kind == _NO_BLOCK:
             self.pool.no_block(peer.id, height)
         elif kind == _STATUS_REQ:

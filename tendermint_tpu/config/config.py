@@ -71,12 +71,18 @@ class P2PConfig:
     addr_book_file: str = "config/addrbook.json"
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
+    # per-connection rate caps, bytes/s (reference config SendRate/
+    # RecvRate, default 5120000); 0 disables throttling
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
 
     def validate_basic(self) -> None:
         if self.max_num_inbound_peers < 0:
             raise ValueError("p2p.max_num_inbound_peers cannot be negative")
         if self.max_num_outbound_peers < 0:
             raise ValueError("p2p.max_num_outbound_peers cannot be negative")
+        if self.send_rate < 0 or self.recv_rate < 0:
+            raise ValueError("p2p rate caps cannot be negative")
 
     def peer_list(self, s: str) -> list[str]:
         return [p.strip() for p in s.split(",") if p.strip()]

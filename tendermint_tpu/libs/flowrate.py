@@ -23,6 +23,7 @@ class Status:
 class Monitor:
     def __init__(self, sample_period: float = 0.1, window: float = 1.0):
         self._sample = sample_period
+        self._window = window
         self._alpha = sample_period / window
         self._start = time.monotonic()
         self._total = 0
@@ -30,6 +31,10 @@ class Monitor:
         self._last = self._start
         self._rate = 0.0
         self._peak = 0.0
+        # token bucket for limit(): credit accrues at the cap and is
+        # clamped to one window's burst
+        self._tokens = 0.0
+        self._tok_time = 0.0
 
     def update(self, n: int) -> None:
         self._total += n
@@ -46,10 +51,22 @@ class Monitor:
     def status(self) -> Status:
         now = time.monotonic()
         dur = now - self._start
+        # idle decay: a transfer that stops must see its cur_rate fall
+        # toward zero (a stalled peer otherwise keeps its last EMA
+        # forever and a min-rate check can never trip); apply the EMA
+        # update as if the pending bytes arrived over the elapsed time
+        # and nothing after
+        rate = self._rate
+        idle = now - self._last
+        if self._sample > 0 and idle >= self._sample:
+            steps = idle / self._sample
+            inst = self._acc / idle
+            decay = (1.0 - self._alpha) ** steps
+            rate = rate * decay + inst * (1.0 - decay)
         return Status(
             start=self._start,
             bytes_total=self._total,
-            cur_rate=self._rate,
+            cur_rate=rate,
             avg_rate=self._total / dur if dur > 0 else 0.0,
             peak_rate=self._peak,
             duration=dur,
@@ -57,9 +74,28 @@ class Monitor:
 
     def limit(self, want: int, max_rate: float) -> int:
         """How many of `want` bytes may transfer now to stay under
-        max_rate (0 = unlimited)."""
+        max_rate (0 = unlimited).
+
+        Token bucket with the burst clamped to one window of credit
+        (reference flowrate.Limit): idle or under-cap time must not bank
+        unbounded credit, or a later burst streams unthrottled. A return
+        value equal to `want` CONSUMES the budget (the caller transfers
+        those bytes); partial grants are advisory and consume nothing
+        (callers retry until the full amount fits)."""
         if max_rate <= 0:
             return want
-        dur = time.monotonic() - self._start
-        budget = max_rate * (dur + self._sample) - self._total
-        return max(0, min(want, int(budget)))
+        now = time.monotonic()
+        if self._tok_time == 0.0:
+            # start with one window of burst, like an idle-for-a-window
+            # bucket — small messages never wait
+            self._tokens = max_rate * self._window
+        else:
+            self._tokens = min(
+                self._tokens + max_rate * (now - self._tok_time),
+                max_rate * self._window,
+            )
+        self._tok_time = now
+        if want <= self._tokens:
+            self._tokens -= want
+            return want
+        return max(0, int(self._tokens))

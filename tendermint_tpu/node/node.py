@@ -275,7 +275,12 @@ class Node(Service):
             )
 
         transport = MultiplexTransport(self.node_key, node_info)
-        sw = Switch(transport, logger=self.logger)
+        sw = Switch(
+            transport,
+            logger=self.logger,
+            send_rate=config.p2p.send_rate,
+            recv_rate=config.p2p.recv_rate,
+        )
         self.transport = transport
         self.switch = sw
         sw.add_reactor("consensus", self.consensus_reactor)

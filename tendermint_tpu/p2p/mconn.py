@@ -20,11 +20,18 @@ import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
+from ..libs.flowrate import Monitor
 from ..libs.log import Logger, nop_logger
 
 MAX_PACKET_PAYLOAD = 1000
 _PING = 0xFE
 _PONG = 0xFF
+
+# reference p2p/conn/connection.go defaultSendRate/defaultRecvRate:
+# 512000 B/s (500 KB/s) per connection; 0 disables throttling
+DEFAULT_SEND_RATE = 512000
+DEFAULT_RECV_RATE = 512000
+_THROTTLE_TICK = 0.05
 
 
 @dataclass
@@ -69,6 +76,8 @@ class MConnection:
         on_receive: Callable[[int, bytes], Awaitable[None]],
         on_error: Optional[Callable[[Exception], Awaitable[None]]] = None,
         ping_interval: float = 10.0,
+        send_rate: int = DEFAULT_SEND_RATE,
+        recv_rate: int = DEFAULT_RECV_RATE,
         logger: Optional[Logger] = None,
     ):
         self._conn = conn
@@ -76,11 +85,24 @@ class MConnection:
         self._on_receive = on_receive
         self._on_error = on_error
         self._ping_interval = ping_interval
+        self._send_rate = send_rate
+        self._recv_rate = recv_rate
+        # public: peer-quality metrics read these (reference Status())
+        self.send_monitor = Monitor()
+        self.recv_monitor = Monitor()
         self.logger = logger or nop_logger()
         self._tasks: list[asyncio.Task] = []
         self._send_signal = asyncio.Event()
         self._running = False
         self._errored = False
+
+    async def _throttle(self, mon: Monitor, want: int, rate: int) -> None:
+        """Block until `want` bytes fit the rate budget (reference
+        sendRoutine/recvRoutine flowrate.Limit)."""
+        if rate <= 0:
+            return
+        while mon.limit(want, rate) < want:
+            await asyncio.sleep(_THROTTLE_TICK)
 
     def start(self) -> None:
         self._running = True
@@ -148,7 +170,9 @@ class MConnection:
             return False
         chunk, eof = best.next_packet()
         pkt = bytes([best.desc.id, 1 if eof else 0]) + chunk
+        await self._throttle(self.send_monitor, len(pkt), self._send_rate)
         await self._conn.write(pkt)
+        self.send_monitor.update(len(pkt))
         # decay counters so priorities stay relative
         for ch in self._channels.values():
             ch.recently_sent = int(ch.recently_sent * 0.8)
@@ -157,10 +181,14 @@ class MConnection:
     async def _recv_routine(self) -> None:
         try:
             while self._running:
+                await self._throttle(
+                    self.recv_monitor, MAX_PACKET_PAYLOAD, self._recv_rate
+                )
                 pkt = await self._read_packet()
                 if pkt is None:
                     continue
                 ch_id, eof, chunk = pkt
+                self.recv_monitor.update(len(chunk) + 2)
                 if ch_id == _PING:
                     await self._conn.write(bytes([_PONG, 1]))
                     continue

@@ -49,6 +49,8 @@ class Switch(Service):
         transport: MultiplexTransport,
         logger: Optional[Logger] = None,
         max_peers: int = 50,
+        send_rate: int = 0,
+        recv_rate: int = 0,
     ):
         super().__init__("p2p-switch", logger)
         self.transport = transport
@@ -56,6 +58,11 @@ class Switch(Service):
         self._channel_to_reactor: dict[int, Reactor] = {}
         self.peers: dict[str, Peer] = {}
         self.max_peers = max_peers
+        # per-connection byte-rate caps (reference MConnConfig SendRate/
+        # RecvRate, p2p/conn/connection.go:78-210); 0 = unthrottled —
+        # nodes pass config.p2p values, tests default to unlimited
+        self.send_rate = send_rate
+        self.recv_rate = recv_rate
         self.dialing: set[str] = set()
         self._persistent_addrs: list[NetAddress] = []
 
@@ -156,7 +163,14 @@ class Switch(Service):
             if peer_holder:
                 await self.stop_peer_for_error(peer_holder[0], repr(err))
 
-        mconn = MConnection(sconn, descs, on_receive, on_error)
+        mconn = MConnection(
+            sconn,
+            descs,
+            on_receive,
+            on_error,
+            send_rate=self.send_rate,
+            recv_rate=self.recv_rate,
+        )
         peer = Peer(info, sconn, mconn, outbound, addr)
         peer_holder.append(peer)
         self.peers[peer.id] = peer

@@ -111,6 +111,47 @@ def test_mconn_multiplexing_priorities():
     asyncio.run(run())
 
 
+def test_mconn_send_rate_throttling():
+    """send_rate caps sustained throughput (reference connection.go
+    flowrate Limit in sendRoutine): pushing ~3x the per-second budget
+    must take measurably longer than an unthrottled send."""
+    import time as _time
+
+    async def run():
+        (r1, w1), (r2, w2), server = await _pipe_pair()
+        k1, k2 = ed25519.PrivKey.generate(), ed25519.PrivKey.generate()
+        c1, c2 = await asyncio.gather(
+            SecretConnection.make(r1, w1, k1),
+            SecretConnection.make(r2, w2, k2),
+        )
+        got = asyncio.Queue()
+
+        async def on_recv(ch, msg):
+            await got.put(msg)
+
+        descs = [ChannelDescriptor(id=0x20)]
+        m1 = MConnection(c1, descs, lambda ch, m: asyncio.sleep(0),
+                         send_rate=20000)
+        m2 = MConnection(c2, descs, on_recv, recv_rate=0)
+        m1.start(); m2.start()
+        # 60 KB at a 20 kB/s cap: the token bucket's one-window burst
+        # (20 KB) goes instantly, the remaining 40 KB must take >= ~2s
+        payload = b"T" * 60000
+        t0 = _time.monotonic()
+        assert m1.send(0x20, payload)
+        msg = await asyncio.wait_for(got.get(), 15)
+        elapsed = _time.monotonic() - t0
+        assert msg == payload
+        assert elapsed > 1.0, f"send not throttled ({elapsed:.2f}s)"
+        # sustained-rate property: burst + rate*elapsed bounds the bytes
+        st = m1.send_monitor.status()
+        assert st.bytes_total >= len(payload)
+        assert st.bytes_total <= 20000 * (elapsed + 1.5) + 20000
+        await m1.stop(); await m2.stop(); server.close()
+
+    asyncio.run(run())
+
+
 def _make_switch(name: str, reactors=None, network=NETWORK):
     nk = NodeKey.generate()
     transport = None
