@@ -82,12 +82,22 @@ def decode_records(
             if lenient:
                 return
             raise WALCorruption("crc mismatch")
-        f = pio.decode_fields(payload)
-        yield WALMessage(
-            kind=f[1][0].decode(),
-            data=f.get(3, [b""])[0],
-            timestamp_ns=f.get(2, [0])[0],
-        )
+        try:
+            f = pio.decode_fields(payload)
+            msg = WALMessage(
+                kind=f[1][0].decode(),
+                data=f.get(3, [b""])[0],
+                timestamp_ns=f.get(2, [0])[0],
+            )
+        except (KeyError, IndexError, ValueError, EOFError, TypeError,
+                AttributeError, UnicodeDecodeError) as e:
+            # CRC-valid but structurally hostile payload (a crafted WAL,
+            # not a torn tail): surface as corruption, never as a raw
+            # decoder exception (fuzz target, reference test/fuzz shape)
+            if lenient:
+                return
+            raise WALCorruption(f"malformed record payload: {e}") from None
+        yield msg
 
 
 class WAL:

@@ -31,6 +31,7 @@ class Monitor:
         self._last = self._start
         self._rate = 0.0
         self._peak = 0.0
+        self._seeded = False  # EMA primes with the first sample
         # token bucket for limit(): credit accrues at the cap and is
         # clamped to one window's burst
         self._tokens = 0.0
@@ -43,7 +44,16 @@ class Monitor:
         dt = now - self._last
         if dt >= self._sample:
             inst = self._acc / dt
-            self._rate += self._alpha * (inst - self._rate)
+            if not self._seeded:
+                # seed with the first sample (as the reference flowrate
+                # does): EMA-ing up from 0 with alpha = sample/window
+                # would under-report the true rate ~window/sample-fold
+                # for the first seconds — long enough to trip min-rate
+                # bans against healthy peers
+                self._rate = inst
+                self._seeded = True
+            else:
+                self._rate += self._alpha * (inst - self._rate)
             self._peak = max(self._peak, self._rate)
             self._acc = 0
             self._last = now
@@ -61,8 +71,13 @@ class Monitor:
         if self._sample > 0 and idle >= self._sample:
             steps = idle / self._sample
             inst = self._acc / idle
-            decay = (1.0 - self._alpha) ** steps
-            rate = rate * decay + inst * (1.0 - decay)
+            if not self._seeded:
+                # mirror update()'s first-sample seeding: before any
+                # sample lands, the pending bytes ARE the best estimate
+                rate = inst
+            else:
+                decay = (1.0 - self._alpha) ** steps
+                rate = rate * decay + inst * (1.0 - decay)
         return Status(
             start=self._start,
             bytes_total=self._total,
@@ -85,14 +100,16 @@ class Monitor:
         if max_rate <= 0:
             return want
         now = time.monotonic()
+        # burst cap: one window of credit, but never below one `want` —
+        # a cap smaller than a single transfer unit (e.g. send_rate
+        # below one packet) must delay the transfer, not deadlock it
+        burst = max(max_rate * self._window, float(want))
         if self._tok_time == 0.0:
-            # start with one window of burst, like an idle-for-a-window
-            # bucket — small messages never wait
-            self._tokens = max_rate * self._window
+            # start with a full bucket: small messages never wait
+            self._tokens = burst
         else:
             self._tokens = min(
-                self._tokens + max_rate * (now - self._tok_time),
-                max_rate * self._window,
+                self._tokens + max_rate * (now - self._tok_time), burst
             )
         self._tok_time = now
         if want <= self._tokens:

@@ -119,6 +119,11 @@ def iter_fields(data: bytes):
             yield fnum, wt, read_uvarint(buf)
         elif wt == WIRE_BYTES:
             n = read_uvarint(buf)
+            # a 10-byte uvarint encodes up to 2^70: bound-check BEFORE
+            # read(n) or a hostile length raises OverflowError/MemoryError
+            # instead of a clean decode failure (wire fuzz finding)
+            if n > len(data):
+                raise EOFError("bytes field length exceeds buffer")
             chunk = buf.read(n)
             if len(chunk) != n:
                 raise EOFError("truncated bytes field")

@@ -99,7 +99,16 @@ class AddrBook:
             {} for _ in range(OLD_BUCKET_COUNT)
         ]
         if path and os.path.exists(path):
-            self._load()
+            try:
+                self._load()
+            except Exception:
+                # a corrupt on-disk book (crash mid-save, hostile edit)
+                # must not wedge node startup: the book is a best-effort
+                # cache — start over empty (reference go-fuzz addrbook
+                # target asserts no panic on arbitrary input)
+                self._addrs = {}
+                self._new = [{} for _ in range(NEW_BUCKET_COUNT)]
+                self._old = [{} for _ in range(OLD_BUCKET_COUNT)]
 
     # --- bucket placement (addrbook.go:830-878) ---------------------------
 

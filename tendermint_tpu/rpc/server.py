@@ -88,7 +88,12 @@ class RPCServer(Service):
                     await self._handle_ws(reader, writer, headers)
                     return
                 body = b""
-                n = int(headers.get("content-length", 0))
+                try:
+                    n = int(headers.get("content-length", 0))
+                except ValueError:
+                    break  # malformed header: drop the connection
+                if n < 0 or n > (1 << 24):
+                    break
                 if n:
                     body = await reader.readexactly(n)
                 resp = await self._dispatch_http(method, target, body)
@@ -113,28 +118,43 @@ class RPCServer(Service):
         if method == "POST" and body:
             try:
                 req = json.loads(body)
-            except json.JSONDecodeError:
+            except (json.JSONDecodeError, UnicodeDecodeError, ValueError):
+                # invalid UTF-8 raises UnicodeDecodeError, not
+                # JSONDecodeError (fuzz finding) — both are parse errors
                 return _err(None, -32700, "parse error")
             if isinstance(req, list):
                 return [await self._call_one(r) for r in req]
             return await self._call_one(req)
         # GET style: /method?param=value (reference uri handlers)
-        u = urlparse(target)
-        name = u.path.lstrip("/")
-        params = {
-            k: v[0] for k, v in parse_qs(u.query).items()
-        }
+        try:
+            u = urlparse(target)
+            name = u.path.lstrip("/")
+            params = {
+                k: v[0] for k, v in parse_qs(u.query).items()
+            }
+        except (ValueError, UnicodeDecodeError):
+            # urlparse raises on hostile targets ("Invalid IPv6 URL")
+            return _err(None, -32700, "parse error")
         return await self._call_one(
             {"jsonrpc": "2.0", "id": -1, "method": name or "help",
              "params": params}
         )
 
-    async def _call_one(self, req: dict) -> dict:
+    async def _call_one(self, req) -> dict:
+        # hostile-input guards (fuzz target): a JSON body is not
+        # necessarily an object, and method/params not necessarily the
+        # right shapes — answer with JSON-RPC errors, never raise
+        if not isinstance(req, dict):
+            return _err(None, -32600, "invalid request: not an object")
         rid = req.get("id", -1)
         name = req.get("method", "")
+        if not isinstance(name, str):
+            return _err(rid, -32600, "invalid request: bad method")
         params = req.get("params") or {}
         if isinstance(params, list):
             params = {str(i): p for i, p in enumerate(params)}
+        if not isinstance(params, dict):
+            return _err(rid, -32602, "invalid params: not an object")
         fn = self.core.routes().get(name)
         if fn is None:
             return _err(rid, -32601, f"method {name!r} not found")
@@ -194,13 +214,29 @@ class RPCServer(Service):
                     break
                 try:
                     req = json.loads(data)
-                except json.JSONDecodeError:
+                except (json.JSONDecodeError, UnicodeDecodeError,
+                        ValueError):
+                    continue
+                if not isinstance(req, dict):
                     continue
                 name = req.get("method", "")
                 params = req.get("params") or {}
                 rid = req.get("id", -1)
+                # hostile-shape guards, mirroring _call_one: params must
+                # be an object and the query a string, or the branches
+                # below raise out of the connection task
+                if not isinstance(params, dict):
+                    await send_json(
+                        _err(rid, -32602, "invalid params: not an object")
+                    )
+                    continue
                 if name == "subscribe":
                     q = params.get("query", "")
+                    if not isinstance(q, str):
+                        await send_json(
+                            _err(rid, -32602, "invalid query")
+                        )
+                        continue
                     try:
                         sub = self.core.subscribe_ws(id(writer), q)
                     except Exception as e:
@@ -214,6 +250,11 @@ class RPCServer(Service):
                     )
                 elif name == "unsubscribe":
                     q = params.get("query", "")
+                    if not isinstance(q, str):
+                        await send_json(
+                            _err(rid, -32602, "invalid query")
+                        )
+                        continue
                     ent = subs.pop(q, None)
                     if ent:
                         ent[1].cancel()
