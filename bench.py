@@ -302,6 +302,145 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
     except Exception as e:
         print(f"# sha256 metric failed: {e}", file=sys.stderr)
 
+    # --- blocksync bulk replay (BASELINE config 4, tools/bench_replay) ---
+    try:
+        from tests.helpers import (
+            CHAIN_ID,
+            make_validators,
+            sign_commit,
+        )
+        from tendermint_tpu.crypto.batch_verifier import BatchVerifier
+        from tendermint_tpu.types.block_id import BlockID
+        from tendermint_tpu.types.part_set import PartSetHeader
+
+        n_blocks, n_vals = 48, 128
+        vs_r, pvs_r = make_validators(n_vals)
+        entries = []
+        for h in range(1, n_blocks + 1):
+            hb = h.to_bytes(4, "big") * 8
+            bid = BlockID(hb, PartSetHeader(1, hb))
+            entries.append((bid, h, sign_commit(vs_r, pvs_r, h, 0, bid)))
+        verifier = BatchVerifier()
+        verifier.warm([v.pub_key.data for v in vs_r.validators], bulk=True)
+        assert all(
+            vs_r.verify_commits_light(CHAIN_ID, entries, verifier=verifier)
+        )  # warm the bucket
+        t0 = time.perf_counter()
+        assert all(
+            vs_r.verify_commits_light(CHAIN_ID, entries, verifier=verifier)
+        )
+        rate = n_blocks * n_vals / (time.perf_counter() - t0)
+        out.append(
+            {
+                "metric": "blocksync_replay_throughput",
+                "value": round(rate, 1),
+                "unit": "sigs/s (windowed multi-commit)",
+                "vs_baseline": round(rate / BASELINE_SERIAL_SIGS_PER_S, 3),
+            }
+        )
+    except Exception as e:
+        print(f"# blocksync replay metric failed: {e}", file=sys.stderr)
+
+    # --- light-client bisection (BASELINE config 5) ----------------------
+    try:
+        rate, n_sigs, dt = _bench_light_bisection()
+        out.append(
+            {
+                "metric": "light_bisection_throughput",
+                "value": round(rate, 1),
+                "unit": f"sigs/s ({n_sigs} sigs, {dt*1e3:.0f} ms skip-verify)",
+                "vs_baseline": round(rate / BASELINE_SERIAL_SIGS_PER_S, 3),
+            }
+        )
+    except Exception as e:
+        print(f"# light bisection metric failed: {e}", file=sys.stderr)
+
+    # --- vote-path latency through the micro-batcher ---------------------
+    try:
+        for m in _bench_vote_latency():
+            out.append(m)
+    except Exception as e:
+        print(f"# vote latency metric failed: {e}", file=sys.stderr)
+
+    return out
+
+
+def _bench_light_bisection():
+    """Distant-header skip-verify over a generated chain: the bisection
+    shape of BASELINE config 5 (reference light/client_benchmark_test.go
+    runs the same in-proc mock-provider harness, no stored numbers)."""
+    import asyncio
+
+    from tests.test_light import make_chain, make_client
+
+    chain = make_chain(32, n_vals=128)
+
+    async def run():
+        c = make_client(chain)
+        lb = await c.verify_light_block_at_height(32)
+        assert lb.height == 32
+        return len(c.primary.requests)
+
+    # warm (compile the commit-verify bucket), then measure a fresh client
+    asyncio.run(run())
+    t0 = time.perf_counter()
+    requests = asyncio.run(run())
+    dt = time.perf_counter() - t0
+    # each verified light block costs one 128-signature commit verify
+    n_sigs = requests * 128
+    return n_sigs / dt, n_sigs, dt
+
+
+def _bench_vote_latency():
+    """p50/p99 single-vote latency through the adaptive VoteBatcher at
+    1/64/512 concurrent submissions (SURVEY §7.3 hard part 3: consensus
+    wants latency, the device wants batches). vs_baseline is the serial
+    single-core drain model: c votes x ~65 us each."""
+    import asyncio
+
+    from tendermint_tpu.consensus.vote_batcher import VoteBatcher
+    from tendermint_tpu.crypto import ed25519 as hosted
+
+    pv = hosted.PrivKey.generate()
+    pub = pv.public_key().data
+    votes = [(b"vote-%d" % i, pv.sign(b"vote-%d" % i)) for i in range(512)]
+    batcher = VoteBatcher()
+    lat: dict[int, list] = {}
+
+    async def one(i):
+        t0 = time.perf_counter()
+        ok = await batcher.submit(pub, votes[i][0], votes[i][1])
+        assert ok
+        return time.perf_counter() - t0
+
+    async def run():
+        for c in (1, 64, 512):
+            # throwaway round first: each concurrency lands in a new
+            # batch bucket whose one-time compile must not pollute p99
+            await asyncio.gather(*(one(i) for i in range(c)))
+            lat[c] = list(
+                await asyncio.gather(*(one(i) for i in range(c)))
+            )
+        batcher.stop()
+
+    asyncio.run(run())
+    serial_us = 1e6 / BASELINE_SERIAL_SIGS_PER_S  # ~65 us/verify
+
+    def pct(xs, q):
+        return sorted(xs)[min(len(xs) - 1, int(q * len(xs)))]
+
+    out = []
+    for c, q, name in ((1, 0.5, "p50"), (64, 0.99, "p99"), (512, 0.99, "p99")):
+        v = pct(lat[c], q) * 1e3
+        baseline_ms = c * serial_us / 1e3
+        out.append(
+            {
+                "metric": f"vote_latency_{name}_c{c}",
+                "value": round(v, 1),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / v, 3) if v else 0.0,
+            }
+        )
     return out
 
 

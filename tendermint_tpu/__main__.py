@@ -406,27 +406,27 @@ def cmd_debug_dump(args) -> int:
 
 
 def cmd_probe_upnp(args) -> int:
-    """SSDP-probe for a UPnP gateway (reference probe_upnp.go). Prints
-    the discovery outcome; NAT traversal is not attempted beyond this."""
-    import socket
+    """Discover the UPnP gateway and exercise a full map/unmap round
+    trip on a probe port (reference probe_upnp.go)."""
+    from .p2p.upnp import UPnPError, discover
 
-    msg = (
-        b"M-SEARCH * HTTP/1.1\r\nHOST: 239.255.255.250:1900\r\n"
-        b'MAN: "ssdp:discover"\r\nMX: 2\r\n'
-        b"ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1\r\n\r\n"
-    )
-    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    s.settimeout(3.0)
     try:
-        s.sendto(msg, ("239.255.255.250", 1900))
-        data, addr = s.recvfrom(4096)
-        print(f"UPnP gateway at {addr[0]}:\n{data.decode(errors='replace')}")
-        return 0
-    except (socket.timeout, OSError) as e:
+        gw = discover()
+    except UPnPError as e:
         print(f"no UPnP gateway found ({e})")
         return 1
-    finally:
-        s.close()
+    print(f"UPnP gateway: {gw.service_type} at {gw.control_url}")
+    try:
+        print(f"external IP: {gw.get_external_ip()}")
+        probe_port = 26699
+        gw.add_port_mapping(probe_port, probe_port)
+        print(f"mapped probe port {probe_port} -> OK")
+        gw.delete_port_mapping(probe_port)
+        print("unmapped probe port -> OK")
+    except UPnPError as e:
+        print(f"gateway found but mapping failed: {e}")
+        return 1
+    return 0
 
 
 def cmd_version(args) -> int:

@@ -53,12 +53,22 @@ async def _run_one(client: SocketClient, cmd: str, args: list[str]) -> int:
 
 
 async def _amain(args) -> int:
+    grpc_mode = getattr(args, "transport", "socket") == "grpc"
     if args.abci_cmd == "kvstore":
         from .kvstore import KVStoreApplication
 
-        srv = SocketServer(KVStoreApplication(), port=args.port)
+        if grpc_mode:
+            from .grpc_transport import GRPCServer
+
+            srv = GRPCServer(KVStoreApplication(), port=args.port)
+        else:
+            srv = SocketServer(KVStoreApplication(), port=args.port)
         await srv.start()
-        print(f"kvstore ABCI server listening on {srv.port}", flush=True)
+        print(
+            f"kvstore ABCI server listening on {srv.port} "
+            f"({'grpc' if grpc_mode else 'socket'})",
+            flush=True,
+        )
         try:
             await asyncio.Event().wait()
         except (KeyboardInterrupt, asyncio.CancelledError):
@@ -66,7 +76,12 @@ async def _amain(args) -> int:
         await srv.stop()
         return 0
 
-    client = SocketClient(port=args.port)
+    if grpc_mode:
+        from .grpc_transport import GRPCClient
+
+        client = GRPCClient(port=args.port)
+    else:
+        client = SocketClient(port=args.port)
     await client.connect()
     try:
         if args.abci_cmd == "console":
@@ -118,4 +133,8 @@ def register(sub) -> None:
     )
     sp.add_argument("args", nargs="*")
     sp.add_argument("--port", type=int, default=26658)
+    sp.add_argument(
+        "--transport", choices=["socket", "grpc"], default="socket",
+        help="ABCI transport (reference abci-cli --abci)",
+    )
     sp.set_defaults(fn=cmd_abci)

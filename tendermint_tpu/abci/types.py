@@ -221,9 +221,15 @@ def _to_jsonable(obj):
     if isinstance(obj, dict):
         return {k: _to_jsonable(v) for k, v in obj.items()}
     if hasattr(obj, "__dataclass_fields__"):
+        # per-field recursion (NOT asdict, which flattens nested
+        # dataclasses into untyped dicts): a Header's BlockID must
+        # arrive at the remote app as a BlockID
         return {
             "__dc__": type(obj).__name__,
-            "fields": _to_jsonable(asdict(obj)),
+            "fields": {
+                k: _to_jsonable(getattr(obj, k))
+                for k in obj.__dataclass_fields__
+            },
         }
     return obj
 
@@ -259,6 +265,21 @@ _DATACLASSES = {
         ResponseApplySnapshotChunk,
     )
 }
+
+
+def _register_request_types() -> None:
+    """Request-side dataclasses that cross the remote transports
+    (begin_block carries the full Header tree — found driving a real
+    node against an external app, r4)."""
+    from ..types.block import Header
+    from ..types.block_id import BlockID
+    from ..types.part_set import PartSetHeader
+
+    for c in (Header, BlockID, PartSetHeader):
+        _DATACLASSES[c.__name__] = c
+
+
+_register_request_types()
 
 
 def encode_rpc(method: str, args: list) -> bytes:

@@ -33,12 +33,18 @@ class BaseConfig:
     # thread. 0 = host hashing. Enable (e.g. 2048) on real silicon where
     # the device outruns one CPU core's hashlib (~600k sigs/s).
     device_challenge_min: int = 0
+    # external ABCI app: "" = in-process kvstore; "host:port" connects
+    # out via the transport named by `abci` (reference config ProxyApp)
+    proxy_app: str = ""
+    abci: str = "socket"  # socket | grpc (reference config ABCI)
 
     def validate_basic(self) -> None:
         if self.db_backend not in ("sqlite", "memory"):
             raise ValueError(f"unknown db_backend {self.db_backend!r}")
         if self.device_challenge_min < 0:
             raise ValueError("device_challenge_min must be >= 0")
+        if self.abci not in ("socket", "grpc"):
+            raise ValueError(f"unknown abci transport {self.abci!r}")
 
 
 @dataclass
@@ -75,6 +81,9 @@ class P2PConfig:
     # RecvRate, default 5120000); 0 disables throttling
     send_rate: int = 5120000
     recv_rate: int = 5120000
+    # NAT traversal: map the listen port on the UPnP gateway at start
+    # (reference config UPNP, default false)
+    upnp: bool = False
 
     def validate_basic(self) -> None:
         if self.max_num_inbound_peers < 0:

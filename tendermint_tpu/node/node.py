@@ -29,7 +29,7 @@ from ..p2p.pex import AddrBook, PEXReactor
 from ..p2p.switch import Switch
 from ..p2p.transport import MultiplexTransport, NetAddress
 from ..privval.file_pv import FilePV
-from ..proxy.multi_app_conn import AppConns, ClientCreator
+from ..proxy.multi_app_conn import AppConns
 from ..sequencer import (
     BlockBroadcastReactor,
     LocalSigner,
@@ -82,6 +82,26 @@ def init_files(config: Config, logger: Optional[Logger] = None) -> GenesisDoc:
         logger.info("generated genesis", path=gen_path, chain_id=doc.chain_id)
     logger.info("node id", id=nk.id)
     return doc
+
+
+class _ConnProxy:
+    """Delegates the Application call surface to one named AppConns
+    connection (available after proxy_app.start()); the delegated
+    methods are async client methods — every consumer in the tree
+    (executor, handshaker, syncer, statesync reactor, rpc core) awaits
+    coroutine results."""
+
+    def __init__(self, conns, name: str):
+        self._conns = conns
+        self._name = name
+
+    def __getattr__(self, item):
+        conn = getattr(self._conns, self._name)
+        if conn is None:
+            raise RuntimeError(
+                f"proxy app connection {self._name!r} not started"
+            )
+        return getattr(conn, item)
 
 
 class Node(Service):
@@ -155,10 +175,40 @@ class Node(Service):
             from ..l2node.mock import MockL2Node
 
             l2_node = MockL2Node()
-        self.app = app
         self.l2_node = l2_node
-        self.app_client = LocalClient(app)
-        self.proxy_app = AppConns(ClientCreator(lambda: LocalClient(app)))
+        if config.base.proxy_app:
+            # external app process (reference node.go proxy.DefaultClient
+            # Creator): socket or grpc per config.base.abci. ALL app
+            # traffic rides the three named proxy connections — the
+            # executor/handshake on `consensus`, rpc queries on `query`,
+            # statesync serving on `snapshot` (reference
+            # proxy/multi_app_conn.go:24-28).
+            addr = config.base.proxy_app.removeprefix("tcp://")
+            host, _, port_s = addr.rpartition(":")
+            if not host or not port_s.isdigit():
+                raise ValueError(
+                    f"proxy_app must be [tcp://]host:port, got "
+                    f"{config.base.proxy_app!r}"
+                )
+            if config.base.abci == "grpc":
+                from ..abci.grpc_transport import grpc_client_creator
+
+                creator = grpc_client_creator(host, int(port_s))
+            else:
+                from ..proxy.multi_app_conn import remote_client_creator
+
+                creator = remote_client_creator(host, int(port_s))
+            self.proxy_app = AppConns(creator)
+            self.app = _ConnProxy(self.proxy_app, "query")
+            self.app_client = _ConnProxy(self.proxy_app, "consensus")
+            self._snapshot_app = _ConnProxy(self.proxy_app, "snapshot")
+        else:
+            from ..proxy.multi_app_conn import local_client_creator
+
+            self.app = app
+            self.app_client = LocalClient(app)
+            self._snapshot_app = app
+            self.proxy_app = AppConns(local_client_creator(app))
 
         # --- event bus + indexer (node.go:287-347) ---
         self.event_bus = EventBus()
@@ -258,7 +308,7 @@ class Node(Service):
 
         # --- statesync reactor (node.go:916) ---
         self.statesync_reactor = StateSyncReactor(
-            app, syncer=None, logger=self.logger
+            self._snapshot_app, syncer=None, logger=self.logger
         )
 
         # --- p2p (node.go:929-967) ---
@@ -425,6 +475,15 @@ class Node(Service):
         # p2p
         host, port = self._parse_laddr(self.config.p2p.laddr)
         await self.transport.listen(host, port)
+        if self.config.p2p.upnp:
+            # best-effort NAT mapping of the real listen port (reference
+            # node.go getUPNPExternalAddress); failure leaves the node
+            # listening unmapped
+            from ..p2p import upnp as _upnp
+
+            self._upnp_gateway = await _upnp.map_listen_port(
+                self.transport.listen_port, logger=self.logger
+            )
         await self.switch.start()
         peers = [
             NetAddress.parse(p)
@@ -474,7 +533,7 @@ class Node(Service):
             lc, consensus_params=self.consensus.state.consensus_params
         )
         syncer = Syncer(
-            self.app,
+            self._snapshot_app,
             provider,
             self.statesync_reactor.request_chunk,
             logger=self.logger,
@@ -505,4 +564,11 @@ class Node(Service):
             await self.debug_server.stop()
         if self.indexer_service is not None:
             await self.indexer_service.stop()
+        if getattr(self, "_upnp_gateway", None) is not None:
+            from ..p2p import upnp as _upnp
+
+            await _upnp.unmap_listen_port(
+                self._upnp_gateway, self.transport.listen_port,
+                logger=self.logger,
+            )
         await self.proxy_app.stop()

@@ -8,6 +8,34 @@ encrypted). Frames: 1024-byte payload chunks (:455), 4-byte little-endian
 length inside the sealed frame, 12-byte little-endian nonce counter per
 direction.
 
+Security argument (why the HKDF challenge binds like the reference's
+Merlin-transcript STS, secret_connection.go:92-182):
+
+  challenge = HKDF-SHA256(dh_secret || eph_lo || eph_hi)[64:96]
+
+1. The challenge is a PRF output over BOTH ephemeral public keys and the
+   DH secret. An in-path attacker running two separate DH exchanges (its
+   own ephemeral with each honest side) induces different
+   (dh_secret, eph pair) tuples on each leg, hence — HKDF being a PRF —
+   different challenges ch_A != ch_B except with negligible probability.
+2. Identity is proven by an ed25519 signature OVER the challenge. The
+   attacker holds both legs' symmetric keys (it can decrypt and
+   re-encrypt the auth messages), but to impersonate node B toward node
+   A it must present a signature by B over ch_A; B only ever signs its
+   own leg's ch_B. EUF-CMA of ed25519 closes the argument. Substituting
+   EITHER ephemeral key changes the challenge, so there is no
+   key-substitution path around the binding
+   (tests/test_p2p.py::test_secretconn_mitm_eph_substitution_fails).
+3. Differences from the reference are conservative: Merlin hashes the
+   sorted ephemeral keys into a transcript BEFORE key derivation and
+   signs the transcript hash; here the challenge additionally depends on
+   the DH secret itself, a strict superset of bound material, with
+   domain separation via HKDF_INFO.
+4. Cross-protocol signing: the node key signs raw 32-byte challenges
+   here and length-prefixed canonical protos for consensus
+   (types/vote.py sign_bytes — never 32 raw bytes), so a challenge can
+   never collide with a vote/proposal signing payload.
+
 Async over asyncio streams; the AEAD itself is the native C++ library
 (crypto/aead.py).
 """

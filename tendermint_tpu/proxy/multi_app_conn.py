@@ -20,7 +20,7 @@ class ClientCreator:
 
     async def new_client(self):
         client = self._factory()
-        if isinstance(client, SocketClient):
+        if hasattr(client, "connect"):  # socket and grpc remote clients
             await client.connect()
         return client
 

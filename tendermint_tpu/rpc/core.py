@@ -433,8 +433,12 @@ class RPCCore:
                 blocks.append(self._meta_json(m))
         return {"blocks": blocks, "total_count": len(blocks)}
 
-    def abci_info(self) -> dict:
+    async def abci_info(self) -> dict:
+        import asyncio as _aio
+
         info = self.node.app.info()
+        if _aio.iscoroutine(info):  # external app via proxy connection
+            info = await info
         return {
             "response": {
                 "data": info.data,
@@ -444,10 +448,14 @@ class RPCCore:
             }
         }
 
-    def abci_query(self, path="", data="", height=0, prove=False, **_kw):
+    async def abci_query(self, path="", data="", height=0, prove=False, **_kw):
+        import asyncio as _aio
+
         res = self.node.app.query(
             path, _from_hex(data, "data"), int(height), bool(prove)
         )
+        if _aio.iscoroutine(res):  # external app via proxy connection
+            res = await res
         return {
             "response": {
                 "code": res.code,

@@ -1,0 +1,123 @@
+"""Randomized-manifest e2e (reference test/e2e/generator + runner).
+
+The generator's determinism and topology constraints are unit-checked;
+then one seeded manifest is booted across real processes — randomized
+topology, full nodes, and a perturbation schedule — asserting liveness
+and cross-node agreement. CI runs a fixed seed (deterministic shapes);
+`TM_TPU_E2E_SEED` overrides it to explore other topologies."""
+
+import os
+import signal
+import sys
+
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from testnet_generator import (  # noqa: E402
+    TOPOLOGIES,
+    generate_manifest,
+    materialize,
+    peer_indices,
+)
+
+from .test_e2e_multiprocess import (  # noqa: E402
+    _free_ports,
+    _height,
+    _rpc,
+    _spawn,
+    _wait_heights,
+)
+
+
+def test_manifest_determinism_and_constraints():
+    for seed in range(24):
+        m1 = generate_manifest(seed)
+        m2 = generate_manifest(seed)
+        assert m1 == m2, f"seed {seed} not deterministic"
+        vals = [n for n in m1["nodes"] if n["mode"] == "validator"]
+        assert len(vals) >= 4
+        assert m1["topology"] in TOPOLOGIES
+        # at most one perturbed validator (BFT margin of f=1 at 4-5 vals)
+        assert sum(n["perturb"] != "none" for n in vals) <= 1
+    # seeds actually vary the shapes
+    shapes = {
+        (
+            generate_manifest(s)["topology"],
+            len(generate_manifest(s)["nodes"]),
+        )
+        for s in range(24)
+    }
+    assert len(shapes) > 3, f"generator barely varies: {shapes}"
+
+
+def test_topologies_are_connected():
+    """Every topology yields a connected peer graph (so gossip reaches
+    everyone) for all generated sizes."""
+    for topo in TOPOLOGIES:
+        for n in (4, 5, 6, 7):
+            adj = {i: set(peer_indices(topo, i, n)) for i in range(n)}
+            # persistent peers dial both ways: undirected closure
+            for i, ps in list(adj.items()):
+                for j in ps:
+                    adj[j].add(i)
+            seen = {0}
+            stack = [0]
+            while stack:
+                for j in adj[stack.pop()]:
+                    if j not in seen:
+                        seen.add(j)
+                        stack.append(j)
+            assert seen == set(range(n)), f"{topo} n={n} disconnected"
+
+
+def test_randomized_manifest_net_runs(tmp_path):
+    seed = int(os.environ.get("TM_TPU_E2E_SEED", "7"))
+    manifest = generate_manifest(seed)
+    layout = materialize(manifest, str(tmp_path / "net"), _free_ports)
+
+    procs = {}
+    try:
+        for name, spec in layout.items():
+            procs[name] = _spawn(spec["home"])
+        rpc_ports = [s["rpc_port"] for s in layout.values()]
+        val_ports = [
+            s["rpc_port"]
+            for s in layout.values()
+            if s["mode"] == "validator"
+        ]
+        _wait_heights(
+            val_ports, manifest["initial_height_target"], deadline_s=180
+        )
+
+        # perturbation schedule
+        for name, spec in layout.items():
+            if spec["perturb"] == "kill_restart":
+                os.kill(procs[name].pid, signal.SIGKILL)
+                procs[name].wait(timeout=30)
+                survivors = [
+                    s["rpc_port"]
+                    for n2, s in layout.items()
+                    if n2 != name and s["mode"] == "validator"
+                ]
+                target = max(_height(p) for p in survivors) + 2
+                _wait_heights(survivors, target, deadline_s=150)
+                procs[name] = _spawn(spec["home"])
+                catchup = max(_height(p) for p in survivors) + 1
+                _wait_heights([spec["rpc_port"]], catchup, deadline_s=180)
+
+        # everyone (validators AND full nodes) reaches a common height
+        # and agrees on the block hash there
+        target = max(_height(p) for p in val_ports)
+        _wait_heights(rpc_ports, target, deadline_s=180)
+        h = min(_height(p) for p in rpc_ports)
+        hashes = {
+            _rpc(p, "block", height=h)["block_id"]["hash"]
+            for p in rpc_ports
+        }
+        assert len(hashes) == 1, (
+            f"seed {seed} ({manifest['topology']}): fork at height {h}"
+        )
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                os.killpg(p.pid, signal.SIGKILL)

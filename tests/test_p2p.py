@@ -76,6 +76,91 @@ def test_secret_connection_rejects_tampering():
     asyncio.run(run())
 
 
+def test_secretconn_mitm_eph_substitution_fails():
+    """Ephemeral-key-substitution MITM (the attack shape the handshake's
+    security argument rules out — see secret_connection.py docstring):
+    the attacker completes a full DH with EACH side using its own
+    ephemeral keys, holds both legs' symmetric keys, and faithfully
+    re-encrypts the auth payloads across legs. Both honest sides must
+    reject: the relayed signature covers the OTHER leg's challenge."""
+    from tendermint_tpu.p2p.secret_connection import (
+        HKDF_INFO,
+        SecretConnection,
+        _hkdf_sha256,
+        _Nonce,
+    )
+    from tendermint_tpu.crypto import aead as _aead, x25519
+
+    async def run():
+        # two real socket pairs: A<->M and M<->B
+        (ra_a, wa_a), (ra_m, wa_m), srv_a = await _pipe_pair()
+        (rb_m, wb_m), (rb_b, wb_b), srv_b = await _pipe_pair()
+        ka, kb = ed25519.PrivKey.generate(), ed25519.PrivKey.generate()
+
+        async def mitm():
+            # leg 1: DH with A using the attacker's ephemeral
+            e1_priv, e1_pub = x25519.generate_keypair()
+            a_eph = await ra_m.readexactly(32)
+            wa_m.write(e1_pub)
+            await wa_m.drain()
+            s1 = x25519.shared_secret(e1_priv, a_eph)
+            lo, hi = sorted([e1_pub, a_eph])
+            m1 = _hkdf_sha256(s1 + lo + hi, HKDF_INFO, 96)
+            k1a, k1b = m1[:32], m1[32:64]
+            # attacker's send key toward A mirrors A's recv key
+            m_send1, m_recv1 = (k1b, k1a) if a_eph == lo else (k1a, k1b)
+            # leg 2: DH with B
+            e2_priv, e2_pub = x25519.generate_keypair()
+            wb_m.write(e2_pub)
+            await wb_m.drain()
+            b_eph = await rb_m.readexactly(32)
+            s2 = x25519.shared_secret(e2_priv, b_eph)
+            lo2, hi2 = sorted([e2_pub, b_eph])
+            m2 = _hkdf_sha256(s2 + lo2 + hi2, HKDF_INFO, 96)
+            k2a, k2b = m2[:32], m2[32:64]
+            m_send2, m_recv2 = (k2b, k2a) if b_eph == lo2 else (k2a, k2b)
+
+            async def relay(r, w, recv_key, send_key):
+                from tendermint_tpu.p2p.secret_connection import (
+                    SEALED_FRAME_SIZE,
+                )
+                rn, sn = _Nonce(), _Nonce()
+                try:
+                    while True:
+                        sealed = await r.readexactly(SEALED_FRAME_SIZE)
+                        frame = _aead.open_(recv_key, rn.use(), sealed)
+                        w.write(_aead.seal(send_key, sn.use(), frame))
+                        await w.drain()
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    pass
+
+            await asyncio.gather(
+                relay(ra_m, wb_m, m_recv1, m_send2),
+                relay(rb_m, wa_m, m_recv2, m_send1),
+                return_exceptions=True,
+            )
+
+        mt = asyncio.create_task(mitm())
+
+        async def a_side():
+            return await SecretConnection.make(ra_a, wa_a, ka)
+
+        async def b_side():
+            return await SecretConnection.make(rb_b, wb_b, kb)
+
+        results = await asyncio.gather(
+            a_side(), b_side(), return_exceptions=True
+        )
+        mt.cancel()
+        srv_a.close(); srv_b.close()
+        return results
+
+    results = asyncio.run(run())
+    for r in results:
+        assert isinstance(r, ValueError), f"MITM not detected: {r!r}"
+        assert "challenge auth failed" in str(r)
+
+
 def test_mconn_multiplexing_priorities():
     async def run():
         (r1, w1), (r2, w2), server = await _pipe_pair()

@@ -1,0 +1,157 @@
+"""UPnP port mapping against an in-process fake IGD gateway.
+
+Drives the full reference flow (p2p/upnp/upnp.go): SSDP discovery,
+description fetch, WANIPConnection control-URL resolution, and the SOAP
+AddPortMapping / GetExternalIPAddress / DeletePortMapping actions — all
+against a loopback UDP responder + HTTP server, no real gateway."""
+
+import asyncio
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from tendermint_tpu.p2p import upnp
+
+_DESC_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <deviceList><device>
+   <serviceList>
+    <service>
+     <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+     <controlURL>/ctl/IPConn</controlURL>
+    </service>
+   </serviceList>
+  </device></deviceList>
+ </device>
+</root>"""
+
+
+class _FakeIGD:
+    """SSDP responder + description/SOAP HTTP endpoint on loopback."""
+
+    def __init__(self):
+        self.mappings: dict[int, tuple[int, str]] = {}
+        self.deleted: list[int] = []
+
+        class Handler(BaseHTTPRequestHandler):
+            igd = self
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = _DESC_XML.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode()
+
+                def field(tag):
+                    a = body.find(f"<{tag}>") + len(tag) + 2
+                    b = body.find(f"</{tag}>")
+                    return body[a:b]
+
+                action = self.headers.get("SOAPAction", "")
+                if "AddPortMapping" in action:
+                    ext = int(field("NewExternalPort"))
+                    self.igd.mappings[ext] = (
+                        int(field("NewInternalPort")),
+                        field("NewInternalClient"),
+                    )
+                    resp = "<ok/>"
+                elif "DeletePortMapping" in action:
+                    ext = int(field("NewExternalPort"))
+                    self.igd.mappings.pop(ext, None)
+                    self.igd.deleted.append(ext)
+                    resp = "<ok/>"
+                elif "GetExternalIPAddress" in action:
+                    resp = (
+                        "<NewExternalIPAddress>203.0.113.7"
+                        "</NewExternalIPAddress>"
+                    )
+                else:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                data = resp.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.http = HTTPServer(("127.0.0.1", 0), Handler)
+        self.http_port = self.http.server_port
+        threading.Thread(target=self.http.serve_forever, daemon=True).start()
+
+        # SSDP responder on a loopback UDP port
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind(("127.0.0.1", 0))
+        self.ssdp_addr = self.udp.getsockname()
+
+        def respond():
+            try:
+                while True:
+                    data, addr = self.udp.recvfrom(4096)
+                    if b"M-SEARCH" not in data:
+                        continue
+                    resp = (
+                        "HTTP/1.1 200 OK\r\n"
+                        f"LOCATION: http://127.0.0.1:{self.http_port}/desc\r\n"
+                        "ST: urn:schemas-upnp-org:device:"
+                        "InternetGatewayDevice:1\r\n\r\n"
+                    ).encode()
+                    self.udp.sendto(resp, addr)
+            except OSError:
+                pass
+
+        threading.Thread(target=respond, daemon=True).start()
+
+    def close(self):
+        self.http.shutdown()
+        self.udp.close()
+
+
+def test_discover_map_unmap_roundtrip():
+    igd = _FakeIGD()
+    try:
+        gw = upnp.discover(timeout=3.0, ssdp_addr=igd.ssdp_addr)
+        assert gw.service_type.endswith("WANIPConnection:1")
+        assert gw.control_url.endswith("/ctl/IPConn")
+        gw.add_port_mapping(26656, 26656)
+        assert 26656 in igd.mappings
+        assert igd.mappings[26656][0] == 26656
+        assert gw.get_external_ip() == "203.0.113.7"
+        gw.delete_port_mapping(26656)
+        assert 26656 not in igd.mappings
+        assert igd.deleted == [26656]
+    finally:
+        igd.close()
+
+
+def test_async_map_listen_port_best_effort():
+    igd = _FakeIGD()
+
+    async def run():
+        gw = await upnp.map_listen_port(
+            26700, timeout=3.0, ssdp_addr=igd.ssdp_addr
+        )
+        assert gw is not None
+        assert 26700 in igd.mappings
+        await upnp.unmap_listen_port(gw, 26700)
+        assert 26700 not in igd.mappings
+        # no gateway at a dead address: returns None, never raises
+        dead = await upnp.map_listen_port(
+            26701, timeout=0.3, ssdp_addr=("127.0.0.1", 1)
+        )
+        assert dead is None
+
+    try:
+        asyncio.run(run())
+    finally:
+        igd.close()

@@ -1,0 +1,183 @@
+"""Randomized testnet manifest generator (reference test/e2e/generator).
+
+Produces seeded, deterministic testnet manifests — validator count,
+full-node count, peer topology, per-node knobs, and a perturbation
+schedule — and materializes them into runnable node homes using the same
+`testnet` scaffolding the fixed mp-e2e scenarios use. The e2e runner
+(tests/test_e2e_generator.py) picks a seed, boots the manifest across
+real processes, applies the perturbations, and asserts liveness +
+agreement, so every CI run exercises a (deterministically) different
+topology.
+
+Usage:
+    python tools/testnet_generator.py SEED [OUTDIR]
+prints the manifest; with OUTDIR it also materializes the homes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+TOPOLOGIES = ("mesh", "ring", "star")
+PERTURBATIONS = ("none", "kill_restart")
+
+
+def generate_manifest(seed: int) -> dict:
+    """Deterministic manifest for `seed` (same seed -> same manifest)."""
+    rng = random.Random(seed)
+    n_validators = rng.choice((4, 4, 5))  # quorum-friendly sizes
+    n_fulls = rng.randint(0, 2)
+    topology = rng.choice(TOPOLOGIES)
+    nodes = []
+    for i in range(n_validators):
+        nodes.append(
+            {
+                "name": f"validator{i:02d}",
+                "mode": "validator",
+                # at most one perturbed validator: BFT tolerates f=1 of 4
+                "perturb": "none",
+                "send_rate": rng.choice((0, 5120000)),
+            }
+        )
+    victim = rng.randrange(n_validators)
+    nodes[victim]["perturb"] = rng.choice(PERTURBATIONS)
+    for i in range(n_fulls):
+        nodes.append(
+            {
+                "name": f"full{i:02d}",
+                "mode": "full",
+                "perturb": "none",
+                "send_rate": 0,
+            }
+        )
+    return {
+        "seed": seed,
+        "topology": topology,
+        "initial_height_target": 3,
+        "nodes": nodes,
+    }
+
+
+def peer_indices(topology: str, i: int, n: int) -> list[int]:
+    """Which nodes index i lists as persistent peers."""
+    if topology == "mesh":
+        return [j for j in range(n) if j != i]
+    if topology == "ring":
+        return [(i + 1) % n, (i - 1) % n] if n > 2 else [1 - i]
+    if topology == "star":
+        return [0] if i != 0 else list(range(1, n))
+    raise ValueError(f"unknown topology {topology!r}")
+
+
+def materialize(manifest: dict, base: str, free_ports) -> dict:
+    """Create node homes for the manifest. `free_ports(n)` supplies
+    distinct free localhost ports. Returns
+    {name: {home, rpc_port, p2p_port, perturb, mode}}."""
+    from tendermint_tpu.config import Config
+    from tendermint_tpu.p2p.key import NodeKey
+
+    nodes = manifest["nodes"]
+    validators = [n for n in nodes if n["mode"] == "validator"]
+    chain_id = f"gen-{manifest['seed']}"
+    rc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "tendermint_tpu",
+            "testnet",
+            "--v",
+            str(len(validators)),
+            "--output",
+            base,
+            "--chain-id",
+            chain_id,
+        ],
+        capture_output=True,
+        timeout=120,
+    )
+    if rc.returncode != 0:
+        raise RuntimeError(f"testnet scaffold failed: {rc.stderr.decode()}")
+
+    n = len(nodes)
+    ports = free_ports(2 * n)
+    p2p_ports, rpc_ports = ports[:n], ports[n:]
+    out = {}
+    homes = []
+    for i, spec in enumerate(nodes):
+        if spec["mode"] == "validator":
+            home = os.path.join(base, f"node{len(homes)}")
+        else:
+            # full node: fresh home + the shared genesis, own keys
+            home = os.path.join(base, spec["name"])
+            cfg = Config()
+            cfg.root_dir = home
+            cfg.ensure_dirs()
+            import shutil
+
+            shutil.copy(
+                os.path.join(base, "node0", "config", "genesis.json"),
+                os.path.join(home, "config", "genesis.json"),
+            )
+            cfg.save()
+        homes.append(home)
+        out[spec["name"]] = {
+            "home": home,
+            "p2p_port": p2p_ports[i],
+            "rpc_port": rpc_ports[i],
+            "mode": spec["mode"],
+            "perturb": spec["perturb"],
+        }
+
+    ids = [
+        NodeKey.load_or_generate(
+            os.path.join(h, "config", "node_key.json")
+        ).id
+        for h in homes
+    ]
+    for i, spec in enumerate(nodes):
+        cfg = Config.load(homes[i])
+        cfg.root_dir = homes[i]
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_ports[i]}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_ports[i]}"
+        cfg.p2p.send_rate = spec.get("send_rate", 0)
+        peers = peer_indices(manifest["topology"], i, n)
+        cfg.p2p.persistent_peers = ",".join(
+            f"{ids[j]}@127.0.0.1:{p2p_ports[j]}" for j in peers
+        )
+        cfg.save()
+    return out
+
+
+def main(argv) -> int:
+    if len(argv) < 2:
+        print(__doc__)
+        return 1
+    seed = int(argv[1])
+    manifest = generate_manifest(seed)
+    print(json.dumps(manifest, indent=2))
+    if len(argv) > 2:
+        import socket
+
+        def free_ports(k):
+            socks, ports = [], []
+            for _ in range(k):
+                s = socket.socket()
+                s.bind(("127.0.0.1", 0))
+                socks.append(s)
+                ports.append(s.getsockname()[1])
+            for s in socks:
+                s.close()
+            return ports
+
+        layout = materialize(manifest, argv[2], free_ports)
+        print(json.dumps(layout, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main(sys.argv))
