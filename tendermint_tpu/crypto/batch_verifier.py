@@ -159,10 +159,12 @@ class _TableCache:
             valid = valid.at[:cur].set(self.valid)
         self.tables, self.valid = tables, valid
 
-    def ensure(self, pubkeys: list[bytes]) -> bool:
+    def ensure(self, pubkeys: list[bytes], abort=None) -> bool:
         """Build + install tables for unseen pubkeys. Returns False when
         the batch alone exceeds capacity. The cache resets when full
-        (validator rotation must not silently degrade the hot path)."""
+        (validator rotation must not silently degrade the hot path).
+        `abort` (threading.Event) stops between chunks — shutdown must
+        not wait for a multi-chunk build."""
         with self._lock:
             new = []
             seen = set()
@@ -184,6 +186,8 @@ class _TableCache:
             # chunked builds: big-tier tables are 128 KiB each, so building
             # thousands of keys at once would transiently hold GiBs
             for lo in range(0, len(new), 512):
+                if abort is not None and abort.is_set():
+                    return True  # partial warm is fine; ensure is idempotent
                 chunk = new[lo : lo + 512]
                 b = _bucket(len(chunk), multiple_of=self._nshards)
                 arr = np.zeros((b, 32), dtype=np.uint8)
@@ -325,6 +329,7 @@ class BatchVerifier:
         pubkeys: list[bytes],
         bulk: bool = False,
         key_types: list[str] | None = None,
+        abort=None,
     ) -> None:
         """Pre-build tables for a validator set (e.g. at height change).
         bulk=True also warms the big (fixed-window) tier ahead of a known
@@ -333,7 +338,12 @@ class BatchVerifier:
         key_types (aligned with pubkeys) filters to ed25519 rows; without
         it the 32-byte length heuristic is used, which cannot distinguish
         sr25519 ristretto encodings — pass types for mixed sets so garbage
-        tables are never built for non-edwards keys."""
+        tables are never built for non-edwards keys.
+
+        `abort` (threading.Event) stops the build between chunks: a warm
+        running on a background thread must be interruptible at shutdown
+        — a thread force-terminated mid-XLA-compile takes the process
+        down with it (SIGSEGV/SIGABRT at interpreter exit, found r4)."""
         if key_types is not None:
             eds = [
                 pk
@@ -342,9 +352,9 @@ class BatchVerifier:
             ]
         else:
             eds = [pk for pk in pubkeys if len(pk) == 32]
-        self._small.ensure(eds)
-        if bulk:
-            self._big.ensure(eds)
+        self._small.ensure(eds, abort=abort)
+        if bulk and not (abort is not None and abort.is_set()):
+            self._big.ensure(eds, abort=abort)
 
     # --- verification ------------------------------------------------------
 

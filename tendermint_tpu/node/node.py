@@ -139,6 +139,27 @@ class Node(Service):
         bls_native.native_lib()
         secp_native.native_lib()
         aead._native_lib()
+        # persistent XLA compile cache under the node home: table-build
+        # and verify programs compile once per machine, not once per
+        # process restart. jax is already imported by this module's
+        # import chain, so env vars would be silently ignored — use
+        # jax.config directly (bench.py/conftest.py can use env vars
+        # because they run before any jax import).
+        try:
+            import jax as _jax
+
+            _jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(config.root_dir, "data", "jax_cache"),
+            )
+            _jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1
+            )
+            _jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1
+            )
+        except Exception:
+            pass  # cache is an optimization; never block node startup
         # export the fused device-SHA-512 knob before the first
         # default_verifier() constructs the process-wide verifier
         if config.base.device_challenge_min > 0:
@@ -460,47 +481,60 @@ class Node(Service):
                 getattr(v.pub_key, "type_name", "ed25519")
                 for v in vals.validators
             ]
-            # daemon thread: the build may include a device compile and
-            # must neither block the event loop nor delay shutdown
+            # NON-daemon thread with an abort flag: a daemon thread
+            # force-terminated mid-XLA-compile at interpreter exit
+            # crashes the process (SIGSEGV/SIGABRT — found r4 driving a
+            # short-lived node). on_stop sets the flag and joins; the
+            # interpreter then waits out at most one chunk compile.
             import threading as _threading
 
-            _threading.Thread(
+            self._warm_abort = _threading.Event()
+            self._warm_thread = _threading.Thread(
                 target=self.consensus.verifier.warm,
                 args=(pubs,),
-                kwargs={"key_types": ktypes},
-                daemon=True,
+                kwargs={"key_types": ktypes, "abort": self._warm_abort},
                 name="verifier-warm",
-            ).start()
-
-        # p2p
-        host, port = self._parse_laddr(self.config.p2p.laddr)
-        await self.transport.listen(host, port)
-        if self.config.p2p.upnp:
-            # best-effort NAT mapping of the real listen port (reference
-            # node.go getUPNPExternalAddress); failure leaves the node
-            # listening unmapped
-            from ..p2p import upnp as _upnp
-
-            self._upnp_gateway = await _upnp.map_listen_port(
-                self.transport.listen_port, logger=self.logger
             )
-        await self.switch.start()
-        peers = [
-            NetAddress.parse(p)
-            for p in self.config.p2p.peer_list(
-                self.config.p2p.persistent_peers
-            )
-        ]
-        if peers:
-            self.switch.dial_peers_async(peers, persistent=True)
+            self._warm_thread.start()
 
-        # consensus (blocksync/statesync first when configured)
-        if self.config.statesync.enable:
-            self.spawn(self._run_statesync())
-        elif peers and self.config.blocksync.enable:
-            self.blocksync_reactor.start_sync()
-        else:
-            await self.consensus.start()
+        try:
+            # p2p
+            host, port = self._parse_laddr(self.config.p2p.laddr)
+            await self.transport.listen(host, port)
+            if self.config.p2p.upnp:
+                # best-effort NAT mapping of the real listen port
+                # (reference node.go getUPNPExternalAddress); failure
+                # leaves the node listening unmapped
+                from ..p2p import upnp as _upnp
+
+                self._upnp_gateway = await _upnp.map_listen_port(
+                    self.transport.listen_port, logger=self.logger
+                )
+            await self.switch.start()
+            peers = [
+                NetAddress.parse(p)
+                for p in self.config.p2p.peer_list(
+                    self.config.p2p.persistent_peers
+                )
+            ]
+            if peers:
+                self.switch.dial_peers_async(peers, persistent=True)
+
+            # consensus (blocksync/statesync first when configured)
+            if self.config.statesync.enable:
+                self.spawn(self._run_statesync())
+            elif peers and self.config.blocksync.enable:
+                self.blocksync_reactor.start_sync()
+            else:
+                await self.consensus.start()
+        except BaseException:
+            # failed startup (busy p2p port, bad peer string, ...):
+            # Service.start will not call on_stop, and the non-daemon
+            # warm thread would otherwise hold the interpreter open for
+            # the whole multi-chunk build at exit
+            if getattr(self, "_warm_abort", None) is not None:
+                self._warm_abort.set()
+            raise
 
     async def _run_statesync(self) -> None:
         """Bootstrap from a snapshot, then hand off to consensus
@@ -551,6 +585,12 @@ class Node(Service):
         await self.consensus.start(skip_wal_catchup=True)
 
     async def on_stop(self) -> None:
+        if getattr(self, "_warm_abort", None) is not None:
+            self._warm_abort.set()
+            t = self._warm_thread
+            if t.is_alive():
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, t.join, 120.0)
         if self.consensus.is_running:
             await self.consensus.stop()
         if self.sequencer_reactor.sequencer_started:
