@@ -1449,7 +1449,92 @@ static void g2_msm_pippenger(g2 &out, const g2 *pts,
 // Doubling/infinity pairs (no valid lambda) fall out of the batch and
 // resolve through the generic Jacobian path.
 
+struct g1aff { fp x, y; bool inf; };
 struct g2aff { fp2 x, y; bool inf; };
+
+// G1 version of the halving-rounds batch-affine sum below (same
+// structure over Fp instead of Fp2; the aggregate-N-signatures shape of
+// AggregateSignatures, blssignatures.go:138-149)
+static void g1_sum_batch_affine(g1 &out, g1aff *p, size_t n) {
+    fp *den = new (std::nothrow) fp[n / 2 + 1];
+    fp *pref = new (std::nothrow) fp[n / 2 + 2];
+    size_t *pi = new (std::nothrow) size_t[n / 2 + 1];
+    g1 extra = {FP_ONE_MONT, FP_ONE_MONT, FP_ZERO};
+    if (den == nullptr || pref == nullptr || pi == nullptr) {
+        delete[] den; delete[] pref; delete[] pi;
+        g1 acc = extra;
+        for (size_t i = 0; i < n; i++) {
+            if (p[i].inf) continue;
+            g1 t;
+            g1_add_affine(t, acc, p[i].x, p[i].y);
+            acc = t;
+        }
+        out = acc;
+        return;
+    }
+    while (n > 1) {
+        size_t half = n / 2, m = 0;
+        for (size_t i = 0; i < half; i++) {
+            g1aff &a = p[2 * i], &b = p[2 * i + 1];
+            if (a.inf || b.inf || fp_eq(a.x, b.x)) continue;
+            fp_sub(den[m], b.x, a.x);
+            pi[m] = i;
+            m++;
+        }
+        pref[0] = FP_ONE_MONT;
+        for (size_t j = 0; j < m; j++)
+            fp_mul(pref[j + 1], pref[j], den[j]);
+        fp inv_all;
+        if (m > 0) fp_inv(inv_all, pref[m]);
+        for (size_t j = m; j-- > 0;) {
+            fp dj_inv;
+            fp_mul(dj_inv, pref[j], inv_all);
+            fp_mul(inv_all, inv_all, den[j]);
+            size_t i = pi[j];
+            g1aff &a = p[2 * i], &b = p[2 * i + 1];
+            fp lam, x3, y3, t;
+            fp_sub(t, b.y, a.y);
+            fp_mul(lam, t, dj_inv);
+            fp_sqr(x3, lam);
+            fp_sub(x3, x3, a.x);
+            fp_sub(x3, x3, b.x);
+            fp_sub(t, a.x, x3);
+            fp_mul(y3, lam, t);
+            fp_sub(y3, y3, a.y);
+            a.x = x3;
+            a.y = y3;
+            b.inf = true;
+        }
+        size_t w = 0;
+        for (size_t i = 0; i < half; i++) {
+            g1aff &a = p[2 * i], &b = p[2 * i + 1];
+            if (!b.inf) {
+                g1 t;
+                if (!a.inf) {
+                    g1_add_affine(t, extra, a.x, a.y);
+                    extra = t;
+                }
+                g1_add_affine(t, extra, b.x, b.y);
+                extra = t;
+                continue;
+            }
+            if (a.inf) continue;
+            p[w++] = a;
+        }
+        if (n & 1) p[w++] = p[n - 1];
+        n = w;
+    }
+    delete[] den;
+    delete[] pref;
+    delete[] pi;
+    g1 acc = extra;
+    if (n == 1 && !p[0].inf) {
+        g1 t;
+        g1_add_affine(t, acc, p[0].x, p[0].y);
+        acc = t;
+    }
+    out = acc;
+}
 
 static void g2_sum_batch_affine(g2 &out, g2aff *p, size_t n) {
     // scratch for the shared-inversion chain
@@ -1548,6 +1633,23 @@ static void g2_sum_batch_affine(g2 &out, g2aff *p, size_t n) {
 int tmbls_g1_msm(uint8_t *out, const uint8_t *pts, const uint8_t *ks,
                  size_t n) {
     g1 acc = {FP_ONE_MONT, FP_ONE_MONT, FP_ZERO};
+    if (ks == nullptr && n >= 32) {
+        g1aff *ps = new (std::nothrow) g1aff[n];
+        if (ps != nullptr) {
+            for (size_t i = 0; i < n; i++) {
+                g1 p;
+                int rc = g1_from_wire(p, pts + 96 * i);
+                if (rc < 0) { delete[] ps; return -1; }
+                ps[i].inf = (rc == 0);
+                ps[i].x = p.x;
+                ps[i].y = p.y;
+            }
+            g1_sum_batch_affine(acc, ps, n);
+            delete[] ps;
+            g1_to_wire(out, acc);
+            return 1;
+        }
+    }
     if (ks != nullptr && n >= MSM_MIN) {
         // nothrow: no exception may escape extern "C" into the FFI
         // caller; allocation failure is a resource problem, not bad

@@ -5,131 +5,107 @@
 (*                                                                         *)
 (* Reference counterpart: spec/light-client/verification/                  *)
 (* Lightclient_003_draft.tla in the reference repo (re-specified from the  *)
-(* implementation here, not copied).  The property of interest is the      *)
-(* core soundness argument: if every header the client stores was either   *)
-(* (a) the trusted root or (b) accepted by ValidAndVerified against an     *)
-(* already-stored header inside the trusting period, then — under the      *)
-(* failure model that less than 1/3 of any validator set the client        *)
-(* trusts is faulty — every stored header is a header the main chain      *)
-(* actually produced.                                                      *)
+(* implementation here, not copied).                                       *)
 (*                                                                         *)
-(* Status: syntax-complete TLA+, NOT model-checked in this build           *)
-(* environment (no TLC/Apalache in the image — see spec/tla/README.md).    *)
+(* The model makes FORGERY representable: the attacker may present, at any *)
+(* height, a fake header with an arbitrary validator set, carrying         *)
+(* signatures only from FAULTY validators (honest validators sign only the *)
+(* real chain's header at their height).  Soundness = the client never     *)
+(* stores a fake header.  The r4 machine check                             *)
+(* (tests/test_model_light.py) explores this module's 4-height/4-validator *)
+(* instance exhaustively and validates itself by re-finding the known      *)
+(* attacks when the next-validators continuity check, the 1/3-of-trusted   *)
+(* check, or the <1/3-faulty assumption is dropped.                        *)
 (***************************************************************************)
 
 EXTENDS Integers, FiniteSets
 
 CONSTANTS
-  HEIGHTS,        \* set of chain heights, e.g. 1..Hmax
+  HEIGHTS,        \* chain heights, e.g. 1..Hmax
   VALIDATORS,     \* universe of validator identities
   FAULTY,         \* subset of VALIDATORS that may equivocate/forge
-  TRUSTING_PERIOD,\* duration (abstract time units)
-  TARGET          \* the height the client wants
+  ROOT            \* the subjectively trusted initial height
 
-ASSUME TARGET \in HEIGHTS
+ASSUME ROOT \in HEIGHTS
 
-(* The real chain: one header per height; abstracted as the validator    *)
-(* sets and times the honest chain committed.                            *)
-CONSTANTS ChainVals, ChainNextVals, ChainTime
+(* The real chain: per height, the committed validator set and the       *)
+(* next-validators commitment.                                            *)
+CONSTANTS ChainVals, ChainNextVals
 ASSUME ChainVals \in [HEIGHTS -> SUBSET VALIDATORS]
 ASSUME ChainNextVals \in [HEIGHTS -> SUBSET VALIDATORS]
-ASSUME ChainTime \in [HEIGHTS -> Nat]
+
+(* A header the client may be shown: the real one at h, or a forgery     *)
+(* with attacker-chosen validator sets.                                   *)
+Headers ==
+  [kind: {"real"}, h: HEIGHTS]
+    \union
+  [kind: {"fake"}, h: HEIGHTS, vals: SUBSET VALIDATORS,
+   nextVals: SUBSET VALIDATORS]
+
+HVals(hd) ==
+  IF hd.kind = "real" THEN ChainVals[hd.h] ELSE hd.vals
+HNextVals(hd) ==
+  IF hd.kind = "real" THEN ChainNextVals[hd.h] ELSE hd.nextVals
+
+(* Who can sign header hd: honest validators sign ONLY the real header   *)
+(* at their height; faulty ones sign anything.                            *)
+Signers(hd) ==
+  IF hd.kind = "real"
+  THEN SUBSET (ChainVals[hd.h] \union FAULTY)
+  ELSE SUBSET FAULTY
+
+TwoThirds(S, Of) == 3 * Cardinality(S \intersect Of) > 2 * Cardinality(Of)
+OneThird(S, Of)  == 3 * Cardinality(S \intersect Of) >= Cardinality(Of)
 
 VARIABLES
-  now,            \* wall-clock time at the client
-  trustedStore,   \* set of heights the client has accepted
-  state           \* "working" | "finishedSuccess" | "finishedFail"
+  trustedStore    \* set of Headers the client has accepted
 
-vars == <<now, trustedStore, state>>
+vars == <<trustedStore>>
 
-(***************************************************************************)
-(* Header/commit abstraction.  A commit for height h carries signatures    *)
-(* from a set of validators; honest validators only sign the real chain's  *)
-(* header at h, so a forged header's signers are a subset of FAULTY.       *)
-(***************************************************************************)
+(* Time is elided: for SOUNDNESS the trusting period only removes        *)
+(* verification capability, so "always inside the period" is the         *)
+(* attack-maximal over-approximation.  (Expiry matters for liveness,     *)
+(* which this module does not claim.)                                    *)
 
-\* voting power abstracted to cardinality (the implementation sums powers;
-\* types/validator_set.py:253-)
-TwoThirds(S, Of) == 3 * Cardinality(S) > 2 * Cardinality(Of)
-OneThird(S, Of)  == 3 * Cardinality(S) >= Cardinality(Of)
-
-InTrustingPeriod(h) == now < ChainTime[h] + TRUSTING_PERIOD
-
-(* verify_adjacent (light/verifier.py): sequential step h -> h+1 checks   *)
-(* next_validators_hash continuity + 2/3 of the NEW header's own set.     *)
+(* verify_adjacent (light/verifier.py): h -> h+1 requires the new        *)
+(* header's validator set to MATCH the trusted header's next-validators  *)
+(* commitment (hash continuity), plus 2/3 of that set signing.           *)
 AdjacentOK(th, nh) ==
-  /\ nh = th + 1
-  /\ InTrustingPeriod(th)
-  /\ \E signers \in SUBSET (ChainVals[nh] \union FAULTY) :
-        TwoThirds(signers \intersect ChainVals[nh], ChainVals[nh])
+  /\ nh.h = th.h + 1
+  /\ HVals(nh) = HNextVals(th)
+  /\ \E s \in Signers(nh) : TwoThirds(s, HVals(nh))
 
-(* verify_non_adjacent (skipping): 1/3 of the TRUSTED set must have      *)
-(* signed the new header (the trust intersection), plus 2/3 of the new   *)
-(* header's own set (light/verifier.py; reference verifier.go:58).       *)
+(* verify_non_adjacent (skipping): 1/3 of the TRUSTED header's next      *)
+(* validators must have signed the new header (trust intersection), plus *)
+(* 2/3 of the new header's own set (light/verifier.py; reference         *)
+(* verifier.go:58).                                                      *)
 NonAdjacentOK(th, nh) ==
-  /\ nh > th + 1
-  /\ InTrustingPeriod(th)
-  /\ \E signers \in SUBSET (ChainVals[nh] \union FAULTY) :
-        /\ OneThird(signers \intersect ChainNextVals[th], ChainNextVals[th])
-        /\ TwoThirds(signers \intersect ChainVals[nh], ChainVals[nh])
+  /\ nh.h > th.h + 1
+  /\ \E s \in Signers(nh) :
+       /\ OneThird(s, HNextVals(th))
+       /\ TwoThirds(s, HVals(nh))
 
-(***************************************************************************)
-(* Transitions                                                             *)
-(***************************************************************************)
-
-Init ==
-  /\ now \in Nat
-  /\ trustedStore = {CHOOSE h \in HEIGHTS : TRUE}  \* the subjective root
-  /\ state = "working"
+Init == trustedStore = {[kind |-> "real", h |-> ROOT]}
 
 VerifyStep ==
-  /\ state = "working"
-  /\ \E th \in trustedStore, nh \in HEIGHTS :
-       /\ nh \notin trustedStore
-       /\ AdjacentOK(th, nh) \/ NonAdjacentOK(th, nh)
-       /\ trustedStore' = trustedStore \union {nh}
-  /\ UNCHANGED <<now, state>>
+  \E th \in trustedStore, nh \in Headers :
+    /\ nh \notin trustedStore
+    /\ AdjacentOK(th, nh) \/ NonAdjacentOK(th, nh)
+    /\ trustedStore' = trustedStore \union {nh}
 
-AdvanceTime ==
-  /\ now' \in {t \in Nat : t > now}
-  /\ UNCHANGED <<trustedStore, state>>
+Spec == Init /\ [][VerifyStep]_vars
 
-Finish ==
-  /\ state = "working"
-  /\ \/ /\ TARGET \in trustedStore
-        /\ state' = "finishedSuccess"
-     \/ /\ \A th \in trustedStore : ~InTrustingPeriod(th)
-        /\ state' = "finishedFail"
-  /\ UNCHANGED <<now, trustedStore>>
-
-Next == VerifyStep \/ AdvanceTime \/ Finish
-
-Spec == Init /\ [][Next]_vars
-
-(***************************************************************************)
-(* Properties                                                              *)
-(***************************************************************************)
-
-(* Failure model: in any set the client relies on, faulty validators are  *)
-(* less than 1/3 (the standard Tendermint assumption within the trusting  *)
-(* period).                                                                *)
+(* Failure model: faulty validators are a minority below 1/3 in every    *)
+(* validator set the real chain committed.                               *)
 FaultAssumption ==
   \A h \in HEIGHTS :
-    3 * Cardinality(FAULTY \intersect ChainVals[h])
-      < Cardinality(ChainVals[h])
+    /\ 3 * Cardinality(FAULTY \intersect ChainVals[h])
+         < Cardinality(ChainVals[h])
+    /\ 3 * Cardinality(FAULTY \intersect ChainNextVals[h])
+         < Cardinality(ChainNextVals[h])
 
-(* Soundness: a forged header (one whose honest signers are empty) can    *)
-(* only be accepted if FAULTY alone musters the required thresholds —     *)
-(* excluded by FaultAssumption.  Stated as: every stored height's         *)
-(* accepting signer set contained at least one honest validator of the    *)
-(* real chain's set for that height.                                      *)
+(* Soundness: every stored header is the real chain's header.            *)
 StoreSound ==
-  FaultAssumption =>
-    \A h \in trustedStore :
-      \E v \in ChainVals[h] \ FAULTY : TRUE
-
-(* Termination-shape liveness (checked under fairness of VerifyStep):     *)
-(* the client either reaches TARGET or runs out of trusting period.       *)
-EventuallyDone == <>(state # "working")
+  FaultAssumption => \A hd \in trustedStore : hd.kind = "real"
 
 =============================================================================

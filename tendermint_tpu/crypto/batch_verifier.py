@@ -261,6 +261,13 @@ class BatchVerifier:
         big_impl = (
             _verify_cached_big_mxu if _use_mxu_gather() else _verify_cached_big
         )
+        # process-shutdown flag: the DEFAULT abort for every warm on this
+        # verifier (incl. the executor-threaded bulk warms) — a thread
+        # force-terminated mid-XLA-compile takes the process down, and a
+        # non-daemon one would hold exit for the whole build. Set by the
+        # node on stop, cleared on start (the default verifier is shared
+        # process-wide).
+        self.shutdown_event = threading.Event()
         if mesh is None:
             jit = jax.jit
             self._fn = jit(ed25519_batch.verify_prehashed)
@@ -352,8 +359,10 @@ class BatchVerifier:
             ]
         else:
             eds = [pk for pk in pubkeys if len(pk) == 32]
+        if abort is None:
+            abort = self.shutdown_event
         self._small.ensure(eds, abort=abort)
-        if bulk and not (abort is not None and abort.is_set()):
+        if bulk and not abort.is_set():
             self._big.ensure(eds, abort=abort)
 
     # --- verification ------------------------------------------------------

@@ -148,10 +148,19 @@ class Node(Service):
         try:
             import jax as _jax
 
-            _jax.config.update(
-                "jax_compilation_cache_dir",
-                os.path.join(config.root_dir, "data", "jax_cache"),
+            # machine-level shared dir (content-addressed, multi-process
+            # safe): the multiprocess testnets and every node on a host
+            # amortize the same table-build/verify compiles. An explicit
+            # JAX_COMPILATION_CACHE_DIR in the environment wins.
+            cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or (
+                os.path.join(
+                    os.path.expanduser("~"),
+                    ".cache",
+                    "tendermint_tpu",
+                    "jax_cache",
+                )
             )
+            _jax.config.update("jax_compilation_cache_dir", cache_dir)
             _jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", 1
             )
@@ -421,6 +430,11 @@ class Node(Service):
     # --- lifecycle (node.go:1041-1112) ---------------------------------------
 
     async def on_start(self) -> None:
+        # (re)arm table warms for this process lifetime (the default
+        # verifier — and its shutdown flag — is shared process-wide)
+        ev = getattr(self.consensus.verifier, "shutdown_event", None)
+        if ev is not None:
+            ev.clear()
         await self.proxy_app.start()
         if self.indexer_service is not None:
             await self.indexer_service.start()
@@ -485,10 +499,12 @@ class Node(Service):
             # force-terminated mid-XLA-compile at interpreter exit
             # crashes the process (SIGSEGV/SIGABRT — found r4 driving a
             # short-lived node). on_stop sets the flag and joins; the
-            # interpreter then waits out at most one chunk compile.
+            # interpreter then waits out at most one chunk compile. The
+            # verifier-level shutdown_event also covers the bulk warms
+            # blocksync/light launch via the executor.
             import threading as _threading
 
-            self._warm_abort = _threading.Event()
+            self._warm_abort = self.consensus.verifier.shutdown_event
             self._warm_thread = _threading.Thread(
                 target=self.consensus.verifier.warm,
                 args=(pubs,),
@@ -532,8 +548,9 @@ class Node(Service):
             # Service.start will not call on_stop, and the non-daemon
             # warm thread would otherwise hold the interpreter open for
             # the whole multi-chunk build at exit
-            if getattr(self, "_warm_abort", None) is not None:
-                self._warm_abort.set()
+            ev = getattr(self.consensus.verifier, "shutdown_event", None)
+            if ev is not None:
+                ev.set()
             raise
 
     async def _run_statesync(self) -> None:
@@ -585,12 +602,16 @@ class Node(Service):
         await self.consensus.start(skip_wal_catchup=True)
 
     async def on_stop(self) -> None:
-        if getattr(self, "_warm_abort", None) is not None:
-            self._warm_abort.set()
-            t = self._warm_thread
-            if t.is_alive():
-                loop = asyncio.get_running_loop()
-                await loop.run_in_executor(None, t.join, 120.0)
+        # stop ALL in-flight table warms (the startup thread AND the
+        # bulk warms blocksync/light run in the executor) — see
+        # BatchVerifier.shutdown_event
+        ev = getattr(self.consensus.verifier, "shutdown_event", None)
+        if ev is not None:
+            ev.set()
+        t = getattr(self, "_warm_thread", None)
+        if t is not None and t.is_alive():
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, t.join, 120.0)
         if self.consensus.is_running:
             await self.consensus.stop()
         if self.sequencer_reactor.sequencer_started:

@@ -1,0 +1,155 @@
+"""Machine-check of spec/tla/LightClient.tla (round-4/5 follow-up to the
+ConsensusSafety explorer in test_model_safety.py).
+
+Explores the module's 4-height / 4-validator / 1-faulty instance
+exhaustively: the attacker may present, at any height, a fake header
+with ANY validator set / next-validators pair, signed by any subset of
+FAULTY (honest validators sign only the real chain's header at their
+height). StoreSound = no fake header is ever accepted.
+
+The chain constants cover both a static validator set and a rotation
+(the next-validators commitment changing between heights), since the
+1/3-of-trusted check binds to ChainNextVals of the trusted header.
+
+Self-validation: dropping any one of the three load-bearing guards —
+adjacent next-validators continuity, the non-adjacent 1/3-of-trusted
+threshold, or the <1/3-faulty assumption — must produce a violation.
+"""
+
+import itertools
+
+VALIDATORS = frozenset("abcd")
+FAULTY = frozenset("d")
+HEIGHTS = (1, 2, 3, 4)
+ROOT = 1
+
+# two chain shapes: static set, and a rotation at height 3
+CHAINS = [
+    {
+        "vals": {h: frozenset("abcd") for h in HEIGHTS},
+        "next": {h: frozenset("abcd") for h in HEIGHTS},
+    },
+    {
+        "vals": {1: frozenset("abcd"), 2: frozenset("abcd"),
+                 3: frozenset("abce"), 4: frozenset("abce")},
+        "next": {1: frozenset("abcd"), 2: frozenset("abce"),
+                 3: frozenset("abce"), 4: frozenset("abce")},
+    },
+]
+# the rotation chain introduces validator e; faulty stays {d}
+UNIVERSE = frozenset("abcde")
+
+REAL = "real"
+
+
+def _subsets(s):
+    s = sorted(s)
+    for r in range(len(s) + 1):
+        for c in itertools.combinations(s, r):
+            yield frozenset(c)
+
+
+def _two_thirds(signers, of):
+    return 3 * len(signers & of) > 2 * len(of)
+
+
+def _one_third(signers, of):
+    return 3 * len(signers & of) >= len(of)
+
+
+def _headers(chain, faulty):
+    """All presentable headers: the real one per height + every fake
+    (height, vals, next_vals) combination the attacker could craft.
+    Fake vals range over subsets of the universe; signatures on a fake
+    can only come from FAULTY."""
+    hs = []
+    for h in HEIGHTS:
+        hs.append((REAL, h, None, None))
+        for vals in _subsets(UNIVERSE):
+            if not vals:
+                continue
+            # nextVals only matters for chaining once accepted; a
+            # single adversarial choice (all-faulty) is attack-maximal
+            hs.append(("fake", h, vals, faulty))
+    return hs
+
+
+def _accepts(chain, faulty, th, nh, *, continuity=True, one_third=True):
+    """AdjacentOK \\/ NonAdjacentOK with maximal signer sets (the
+    attacker always contributes every signature it can, honest
+    validators always sign the real header — supersets only help)."""
+    th_kind, th_h, th_vals, th_next = th
+    nh_kind, nh_h, nh_vals, nh_next = nh
+    h_next_of_th = chain["next"][th_h] if th_kind == REAL else th_next
+    if nh_kind == REAL:
+        vals_nh = chain["vals"][nh_h]
+        signers = vals_nh | faulty  # max achievable signer set
+    else:
+        vals_nh = nh_vals
+        signers = faulty
+    if nh_h == th_h + 1:
+        if continuity and vals_nh != h_next_of_th:
+            return False
+        return _two_thirds(signers, vals_nh)
+    if nh_h > th_h + 1:
+        ok = _two_thirds(signers, vals_nh)
+        if one_third:
+            ok = ok and _one_third(signers, h_next_of_th)
+        return ok
+    return False
+
+
+def _explore(chain, faulty, **guards):
+    """BFS over reachable stores; returns True if a fake header is ever
+    accepted."""
+    headers = _headers(chain, faulty)
+    root = (REAL, ROOT, None, None)
+    init = frozenset([root])
+    seen = {init}
+    stack = [init]
+    while stack:
+        store = stack.pop()
+        for th in store:
+            for nh in headers:
+                if nh in store:
+                    continue
+                if _accepts(chain, faulty, th, nh, **guards):
+                    if nh[0] != REAL:
+                        return True
+                    nxt = store | {nh}
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+    return False
+
+
+def test_store_sound_under_fault_assumption():
+    for chain in CHAINS:
+        # sanity: FaultAssumption holds for these constants
+        for h in HEIGHTS:
+            assert 3 * len(FAULTY & chain["vals"][h]) < len(chain["vals"][h])
+            assert 3 * len(FAULTY & chain["next"][h]) < len(chain["next"][h])
+        assert not _explore(chain, FAULTY), (
+            "light client accepted a forged header"
+        )
+
+
+def test_attack_without_adjacent_continuity():
+    """Dropping the next-validators continuity check lets the attacker
+    present an adjacent fake whose own set is all-faulty (2/3 of a set
+    you chose yourself is free)."""
+    assert _explore(CHAINS[0], FAULTY, continuity=False)
+
+
+def test_attack_without_one_third_of_trusted():
+    """Dropping the 1/3-of-trusted threshold on skipping verification
+    reduces non-adjacent acceptance to 2/3 of the fake's own set —
+    attacker-chosen, so forgery goes through."""
+    assert _explore(CHAINS[0], FAULTY, one_third=False)
+
+
+def test_attack_when_fault_assumption_broken():
+    """With >= 1/3 faulty in the trusted next set, the faulty coalition
+    alone satisfies the skipping threshold and forges."""
+    big_faulty = frozenset("cd")  # 2 of 4 >= 1/3
+    assert _explore(CHAINS[0], big_faulty)
