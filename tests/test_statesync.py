@@ -116,6 +116,47 @@ def test_syncer_restores_kvstore_snapshot():
     assert len(set(sent_requests)) == snap.chunks
 
 
+def test_syncer_restores_snapshot_over_grpc_external_app():
+    """The external-app wiring end to end: the DESTINATION app lives in
+    another 'process' behind the gRPC transport (node.py routes the
+    statesync snapshot connection through _ConnProxy -> GRPCClient), so
+    offer_snapshot/apply_snapshot_chunk cross the wire as async client
+    calls — the coroutine-tolerant path in syncer.py."""
+    from tendermint_tpu.abci.grpc_transport import GRPCClient, GRPCServer
+
+    src = _run_source_app()
+    snap = src.list_snapshots()[-1]
+    dst = KVStoreApplication()
+    dst.SNAPSHOT_CHUNK_SIZE = 64
+    provider = DirectStateProvider(
+        src.info().last_block_app_hash, state="STATE", commit="COMMIT"
+    )
+
+    async def run():
+        server = GRPCServer(dst, port=0)
+        await server.start()
+        client = GRPCClient(port=server.port)
+        await client.connect()
+
+        def request_chunk(peer, height, fmt, index):
+            data = src.load_snapshot_chunk(height, fmt, index)
+            syncer.add_chunk(
+                Chunk(height, fmt, index, data, sender=peer.id)
+            )
+
+        syncer = Syncer(client, provider, request_chunk)
+        assert syncer.add_snapshot(FakePeer(), snap)
+        state, commit = await syncer.sync_any(discovery_time=0.1)
+        await client.close()
+        await server.stop()
+        return state, commit
+
+    state, commit = asyncio.run(run())
+    assert state == "STATE" and commit == "COMMIT"
+    assert dst._state == src._state
+    assert dst.info().last_block_app_hash == src.info().last_block_app_hash
+
+
 def test_syncer_rejects_corrupted_snapshot_then_no_snapshots():
     src = _run_source_app()
     snap = src.list_snapshots()[-1]
