@@ -37,6 +37,35 @@ def verify_msgs_batch(
     return verify_digest_batch(pub33s, digests, sigs)
 
 
+def prep_digest_item(pub33: bytes, digest: bytes, sig: bytes):
+    """The consensus-critical host half shared by BOTH batched backends
+    (this native path and the TM_TPU_SECP_DEVICE kernel route in
+    crypto/batch_verifier.py): signature parse, r/s range + low-S
+    malleability check (reference crypto/secp256k1/secp256k1.go:199-210),
+    pubkey decompression, and u1/u2. Returns (r, point, u1, u2) or None
+    for a row that is definitively invalid. ONE implementation — a
+    divergence between backends would be a consensus split."""
+    if len(sig) != 64:
+        return None
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if not (1 <= r < N and 1 <= s <= _HALF_N):
+        return None
+    pt = decompress_point(pub33)
+    if pt is None:
+        return None
+    z = int.from_bytes(digest, "big") % N
+    si = pow(s, -1, N)
+    u1 = z * si % N
+    u2 = r * si % N
+    if u1 == 0 and u2 == 0:
+        # R would be the point at infinity: never a valid signature
+        # (the device kernel reaches the same verdict via its is_inf
+        # mask; rejected here so both backends share the decision)
+        return None
+    return r, pt, u1, u2
+
+
 def verify_digest_batch(
     pub33s: list[bytes], digests: list[bytes], sigs: list[bytes]
 ) -> list[bool]:
@@ -57,24 +86,10 @@ def verify_digest_batch(
     u2_buf = bytearray()
     rs: list[int] = []
     for i in range(n):
-        sig = sigs[i]
-        if len(sig) != 64:
+        prep = prep_digest_item(pub33s[i], digests[i], sigs[i])
+        if prep is None:
             continue
-        r = int.from_bytes(sig[:32], "big")
-        s = int.from_bytes(sig[32:], "big")
-        # low-S malleability check, as the reference
-        # (crypto/secp256k1/secp256k1.go:199-210)
-        if not (1 <= r < N and 1 <= s <= _HALF_N):
-            continue
-        pt = decompress_point(pub33s[i])
-        if pt is None:
-            continue
-        z = int.from_bytes(digests[i], "big") % N
-        si = pow(s, -1, N)
-        u1 = z * si % N
-        u2 = r * si % N
-        if u1 == 0 and u2 == 0:
-            continue
+        r, pt, u1, u2 = prep
         idx.append(i)
         rs.append(r)
         pub_buf += pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")

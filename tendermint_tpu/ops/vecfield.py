@@ -1,0 +1,235 @@
+"""Parameterized radix-2^8 prime-field arithmetic for TPU kernels.
+
+The generalization of ops/bls_g1.py's field scheme (see that module for
+the fully-derived instance with per-step bound commentary): limbs of 8
+bits in int32 lanes, loose invariant limbs < 2^11, carry passes whose
+top carry wraps through the vector constant F0 = 2^(8*NLIMBS) mod p,
+multiplication as a full limb convolution folded by the table
+F[i] = 2^(8*(NLIMBS+i)) mod p, and canonicalization via a Barrett-style
+quotient estimate. Works for any prime whose loose-conv columns stay
+int32-safe: NLIMBS products of < 2^22 requires NLIMBS < 2^9 — true for
+every curve field here.
+
+Instantiated by ops/secp256k1_kernel.py (p = 2^256 - 2^32 - 977);
+ops/bls_g1.py predates the factory and keeps its in-file derivation as
+documentation. Bounds are pinned by per-instance worst-case stress
+tests (tests/test_ops_secp.py, tests/test_ops_bls_g1.py).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def make_field(P: int, nlimbs: int) -> SimpleNamespace:
+    NLIMBS = nlimbs
+
+    def _limbs_of(x: int, n: int = NLIMBS) -> np.ndarray:
+        return np.array(
+            [int(b) for b in x.to_bytes(n, "little")], dtype=np.int32
+        )
+
+    P_LIMBS = _limbs_of(P)
+    F_FOLD = np.stack(
+        [_limbs_of(pow(2, 8 * (NLIMBS + i), P)) for i in range(NLIMBS + 2)]
+    )
+    F0 = F_FOLD[0]
+
+    # additive bias ≡ 0 (mod p) with every limb >= 2048: keeps `sub`
+    # limb-wise non-negative for loose (< 2^11) subtrahends (the 128p
+    # decomposition trick of ops/bls_g1.py, generalized). Construction:
+    # write 128p = [2048 in every limb] + remainder; the remainder's
+    # 2^(8*NLIMBS) overflow folds through F0 (2^(8*NLIMBS) ≡ F0 mod p),
+    # preserving the value mod p. Limbs stay < 2^15 (top ≤ 128, F0
+    # limbs < 256), which two carry passes after `sub` bring back under
+    # the loose invariant.
+    def _bias_limbs() -> np.ndarray:
+        base_val = sum(2048 << (8 * i) for i in range(NLIMBS))
+        rest = 128 * P - base_val
+        assert rest > 0
+        top = rest >> (8 * NLIMBS)
+        db = (rest - (top << (8 * NLIMBS))).to_bytes(NLIMBS, "little")
+        out = np.array(
+            [2048 + db[i] for i in range(NLIMBS)], dtype=np.int64
+        )
+        out += int(top) * F0.astype(np.int64)
+        assert all(2048 <= int(v) < (1 << 15) for v in out)
+        assert sum(int(v) << (8 * i) for i, v in enumerate(out)) % P == 0
+        return out.astype(np.int32)
+
+    BIAS = _bias_limbs()
+
+    MU = (1 << (8 * NLIMBS + 8)) // P
+
+    def from_int(x: int) -> np.ndarray:
+        return _limbs_of(x % P)
+
+    def to_int(limbs) -> int:
+        arr = np.asarray(limbs, dtype=np.int64)
+        return int(sum(int(v) << (8 * i) for i, v in enumerate(arr.tolist())))
+
+    def zeros(shape=()) -> jnp.ndarray:
+        return jnp.zeros((*shape, NLIMBS), dtype=jnp.int32)
+
+    def ones(shape=()) -> jnp.ndarray:
+        z = np.zeros((*shape, NLIMBS), dtype=np.int32)
+        z[..., 0] = 1
+        return jnp.asarray(z)
+
+    def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
+        c = x >> 8
+        r = x - (c << 8)
+        wrap = jnp.concatenate(
+            [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1
+        )
+        return r + wrap + c[..., -1:] * jnp.asarray(F0)
+
+    def add(a, b):
+        return _carry_pass(a + b)
+
+    def sub(a, b):
+        x = a + jnp.asarray(BIAS) - b
+        x = _carry_pass(x)
+        return _carry_pass(x)
+
+    def neg(a):
+        x = jnp.asarray(BIAS) - a
+        x = _carry_pass(x)
+        return _carry_pass(x)
+
+    def _scan_carry(x):
+        xt = jnp.moveaxis(x, -1, 0)
+
+        def step(carry, limb):
+            v = limb + carry
+            c = v >> 8
+            return c, v - (c << 8)
+
+        top, limbs = jax.lax.scan(step, jnp.zeros_like(xt[0]), xt)
+        return jnp.moveaxis(limbs, 0, -1), top
+
+    def mul(a, b):
+        shape = jnp.broadcast_shapes(a.shape, b.shape)[:-1]
+        out = jnp.zeros((*shape, 2 * NLIMBS - 1), dtype=jnp.int32)
+        for i in range(NLIMBS):
+            out = out.at[..., i : i + NLIMBS].add(a[..., i : i + 1] * b)
+        limbs, top = _scan_carry(out)
+        t_lo = top & 255
+        t_hi = top >> 8
+        hi_bytes = jnp.concatenate(
+            [limbs[..., NLIMBS:], t_lo[..., None], t_hi[..., None]], axis=-1
+        )
+        folded = limbs[..., :NLIMBS] + jnp.matmul(
+            hi_bytes, jnp.asarray(F_FOLD[: NLIMBS + 1])
+        )
+        x = folded
+        for _ in range(5):
+            x = _carry_pass(x)
+        return x
+
+    def sqr(a):
+        return mul(a, a)
+
+    def mul_small(a, k: int):
+        assert 0 <= k <= 1 << 14
+        x = a * k
+        x = _carry_pass(x)
+        x = _carry_pass(x)
+        return _carry_pass(x)
+
+    def select(cond, a, b):
+        return jnp.where(cond[..., None], a, b)
+
+    def canonical(x):
+        limbs, top = _scan_carry(x)
+        for _ in range(4):
+            limbs = limbs + top[..., None] * jnp.asarray(F0)
+            limbs, top = _scan_carry(limbs)
+        p_l = jnp.asarray(P_LIMBS)
+        t16 = (limbs[..., NLIMBS - 1] << 8) | limbs[..., NLIMBS - 2]
+        q = jnp.maximum((t16 * MU) >> 24, 0)
+        limbs, _ = _scan_carry(limbs - q[..., None] * p_l)
+        for _ in range(3):
+            diff = limbs - p_l
+            nz = diff != 0
+            idx = (NLIMBS - 1) - jnp.argmax(nz[..., ::-1], axis=-1)
+            ms = jnp.take_along_axis(diff, idx[..., None], axis=-1)[..., 0]
+            geq = jnp.where(jnp.any(nz, axis=-1), ms > 0, True)
+            limbs = limbs - p_l * geq[..., None].astype(jnp.int32)
+            limbs, _ = _scan_carry(limbs)
+        return limbs
+
+    def is_zero(x):
+        return jnp.all(canonical(x) == 0, axis=-1)
+
+    def eq(a, b):
+        return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+    def invert_many(z):
+        """Batched inversion over axis 0: Montgomery trick — prefix and
+        suffix product scans + ONE Fermat chain for the total (mirrors
+        field25519.invert_many). Zero rows invert to zero."""
+        zero = is_zero(z)
+        safe = select(zero, ones(z.shape[:-1]), z)
+        prefix = jax.lax.associative_scan(mul, safe, axis=0)
+        suffix = jax.lax.associative_scan(mul, safe, axis=0, reverse=True)
+        total_inv = invert(prefix[-1])
+        one_row = ones((1,))
+        excl_p = jnp.concatenate([one_row, prefix[:-1]], axis=0)
+        excl_s = jnp.concatenate([suffix[1:], one_row], axis=0)
+        inv = mul(mul(excl_p, excl_s), jnp.broadcast_to(total_inv, z.shape))
+        return select(zero, zeros(z.shape[:-1]), inv)
+
+    # Fermat inversion: z^(p-2), square-and-multiply with the exponent
+    # bits as a device constant and a fori_loop body of one sqr + one
+    # masked mul — a statically-unrolled chain would trace ~2*bits(p)
+    # muls (crypto primes have dense exponents) and blow up compile
+    # time; the loop graph is ~80 ops regardless of the prime.
+    _E_BITS_ARR = np.array(
+        [
+            (P - 2) >> i & 1
+            for i in range((P - 2).bit_length() - 2, -1, -1)
+        ],
+        dtype=np.int32,
+    )
+
+    def invert(z):
+        bits = jnp.asarray(_E_BITS_ARR)
+
+        def body(i, r):
+            r = sqr(r)
+            mz = mul(r, z)
+            return jnp.where((bits[i] == 1)[..., None], mz, r)
+
+        return jax.lax.fori_loop(0, len(_E_BITS_ARR), body, z)
+
+    return SimpleNamespace(
+        P=P,
+        NLIMBS=NLIMBS,
+        P_LIMBS=P_LIMBS,
+        F0=F0,
+        BIAS=BIAS,
+        from_int=from_int,
+        to_int=to_int,
+        zeros=zeros,
+        ones=ones,
+        add=add,
+        sub=sub,
+        neg=neg,
+        mul=mul,
+        sqr=sqr,
+        mul_small=mul_small,
+        select=select,
+        canonical=canonical,
+        is_zero=is_zero,
+        eq=eq,
+        invert=invert,
+        invert_many=invert_many,
+        _carry_pass=_carry_pass,
+        _scan_carry=_scan_carry,
+    )
