@@ -249,15 +249,38 @@ def _verify2(sig, message: bytes, pub: PublicKey, key_validation_mode: bool) -> 
 
 
 def aggregate_public_keys(pubs: list[PublicKey]) -> PublicKey:
+    """Point sum of N G2 public keys — same preference order as
+    aggregate_signatures: native C++ batch-affine sum, then the device
+    tree reduction (ops/bls_g2), then the exact host loop."""
     if native.native_lib() is not None and len(pubs) > 1:
         out = native.g2_msm(
             b"".join(_pub_wire(pk) for pk in pubs), None, len(pubs)
         )
         return new_trusted_public_key(_g2_parse_unchecked(out))
+    if len(pubs) >= DEVICE_AGGREGATE_MIN:
+        try:
+            return new_trusted_public_key(
+                aggregate_public_keys_device(pubs)
+            )
+        except Exception:  # no usable backend: the host paths are exact
+            pass
     acc = c.G2_INF
     for pk in pubs:
         acc = c.g2_add(acc, pk.key)
     return new_trusted_public_key(acc)
+
+
+def aggregate_public_keys_device(pubs: list[PublicKey]):
+    """Sum N G2 keys as a log2(N)-level device tree reduction
+    (ops/bls_g2 — the G2 half of SURVEY §2.2's aggregate kernel row)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..ops import bls_g2 as dev
+
+    pts = np.stack([dev.g2_from_host(pk.key) for pk in pubs])
+    return dev.g2_to_host(dev.g2_aggregate(jnp.asarray(pts)))
 
 
 # host->device switchover for signature aggregation: below this the

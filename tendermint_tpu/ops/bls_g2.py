@@ -1,0 +1,216 @@
+"""Batched BLS12-381 G2 arithmetic on TPU — the pubkey-aggregation kernel.
+
+SURVEY.md §2.2 row "BLS12-381 pairing / aggregate verify", second half:
+aggregate-signature verification aggregates N public keys with N-1 G2
+additions (crypto/bls_signatures.aggregate_public_keys; reference
+blssignatures/bls_signatures.go:138-149 does the same point-add loop in
+G1/G2). ops/bls_g1.py covers the G1 signature side; this module is the
+G2 side — the same masked Jacobian formulas lifted to Fp2, with the
+field layer coming from ops/vecfield.py (the parameterized form of
+bls_g1's radix-2^8 scheme) and Fp2 = Fp[u]/(u^2 + 1) as Karatsuba over
+limb pairs.
+
+Representation: an Fp2 element is [..., 2, 48] (c0, c1); a G2 point is
+[..., 3, 2, 48] Jacobian (X, Y, Z), infinity = Z == 0. Matches the host
+oracle crypto/bls12_381.py (g2_add/g2_double) value-for-value after
+canonicalization.
+
+Routing contract (same as aggregate_signatures / ops/bls_g1): the
+native C++ batch-affine sum leads where available; this kernel takes
+over when the native library is unavailable (no compiler) or the
+deployment pins aggregation on-device — the mesh-scale path, paying a
+one-time compile. The exact serial host loop remains the final
+fallback (crypto/bls_signatures.aggregate_public_keys).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import vecfield
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+NLIMBS = 48
+
+fe = vecfield.make_field(P, NLIMBS)
+
+
+# --- Fp2 = Fp[u]/(u^2 + 1), elements [..., 2, 48] -------------------------
+
+
+def f2_from_host(c) -> np.ndarray:
+    return np.stack([fe.from_int(c[0]), fe.from_int(c[1])])
+
+
+def f2_to_host(x) -> tuple:
+    arr = np.asarray(canonical2_jit(jnp.asarray(x)))
+    return (fe.to_int(arr[..., 0, :]), fe.to_int(arr[..., 1, :]))
+
+
+def f2_zeros(shape=()) -> jnp.ndarray:
+    return jnp.zeros((*shape, 2, NLIMBS), dtype=jnp.int32)
+
+
+def f2_add(a, b):
+    return jnp.stack(
+        [
+            fe.add(a[..., 0, :], b[..., 0, :]),
+            fe.add(a[..., 1, :], b[..., 1, :]),
+        ],
+        axis=-2,
+    )
+
+
+def f2_sub(a, b):
+    return jnp.stack(
+        [
+            fe.sub(a[..., 0, :], b[..., 0, :]),
+            fe.sub(a[..., 1, :], b[..., 1, :]),
+        ],
+        axis=-2,
+    )
+
+
+def f2_mul(a, b):
+    """Karatsuba: 3 base-field muls."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = fe.mul(a0, b0)
+    t1 = fe.mul(a1, b1)
+    m = fe.mul(fe.add(a0, a1), fe.add(b0, b1))
+    return jnp.stack(
+        [fe.sub(t0, t1), fe.sub(fe.sub(m, t0), t1)], axis=-2
+    )
+
+
+def f2_sqr(a):
+    """(a0+a1)(a0-a1), 2*a0*a1 — 2 base-field muls."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    c0 = fe.mul(fe.add(a0, a1), fe.sub(a0, a1))
+    c1 = fe.mul_small(fe.mul(a0, a1), 2)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def f2_mul_small(a, k: int):
+    return jnp.stack(
+        [fe.mul_small(a[..., 0, :], k), fe.mul_small(a[..., 1, :], k)],
+        axis=-2,
+    )
+
+
+def f2_is_zero(a):
+    return fe.is_zero(a[..., 0, :]) & fe.is_zero(a[..., 1, :])
+
+
+def f2_canonical(a):
+    return jnp.stack(
+        [fe.canonical(a[..., 0, :]), fe.canonical(a[..., 1, :])], axis=-2
+    )
+
+
+canonical2_jit = jax.jit(f2_canonical)
+
+
+# --- G2 (Jacobian over Fp2) ------------------------------------------------
+
+
+def g2_identity(shape=()) -> jnp.ndarray:
+    z = np.zeros((*shape, 3, 2, NLIMBS), dtype=np.int32)
+    z[..., 1, 0, 0] = 1  # Y = 1 + 0u
+    return jnp.asarray(z)
+
+
+def g2_from_host(p) -> np.ndarray:
+    return np.stack([f2_from_host(c) for c in p])
+
+
+def g2_to_host(pt) -> tuple:
+    return tuple(f2_to_host(np.asarray(pt)[i]) for i in range(3))
+
+
+def g2_is_inf(p: jnp.ndarray) -> jnp.ndarray:
+    return f2_is_zero(p[..., 2, :, :])
+
+
+def g2_double(p: jnp.ndarray) -> jnp.ndarray:
+    x, y, z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    a = f2_sqr(x)
+    b = f2_sqr(y)
+    c = f2_sqr(b)
+    xb = f2_add(x, b)
+    d = f2_mul_small(f2_sub(f2_sub(f2_sqr(xb), a), c), 2)
+    e = f2_mul_small(a, 3)
+    f = f2_sqr(e)
+    x3 = f2_sub(f, f2_mul_small(d, 2))
+    y3 = f2_sub(f2_mul(e, f2_sub(d, x3)), f2_mul_small(c, 8))
+    z3 = f2_mul_small(f2_mul(y, z), 2)
+    bad = f2_is_zero(y) | f2_is_zero(z)
+    out = jnp.stack([x3, y3, z3], axis=-3)
+    return jnp.where(
+        bad[..., None, None, None], g2_identity(x.shape[:-2]), out
+    )
+
+
+def g2_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Branch-free complete addition (masks for inf/equal/opposite),
+    mirroring ops/bls_g1.g1_add one tower level up."""
+    x1, y1, z1 = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    x2, y2, z2 = q[..., 0, :, :], q[..., 1, :, :], q[..., 2, :, :]
+    z1z1 = f2_sqr(z1)
+    z2z2 = f2_sqr(z2)
+    u1 = f2_mul(x1, z2z2)
+    u2 = f2_mul(x2, z1z1)
+    s1 = f2_mul(f2_mul(y1, z2), z2z2)
+    s2 = f2_mul(f2_mul(y2, z1), z1z1)
+    h = f2_sub(u2, u1)
+    same_x = f2_is_zero(h)
+    r2 = f2_sub(s2, s1)
+    same_y = f2_is_zero(r2)
+    h2 = f2_mul_small(h, 2)
+    i = f2_sqr(h2)
+    j = f2_mul(h, i)
+    rr = f2_mul_small(r2, 2)
+    v = f2_mul(u1, i)
+    x3 = f2_sub(f2_sub(f2_sqr(rr), j), f2_mul_small(v, 2))
+    y3 = f2_sub(
+        f2_mul(rr, f2_sub(v, x3)), f2_mul_small(f2_mul(s1, j), 2)
+    )
+    z3 = f2_mul(
+        f2_sub(f2_sub(f2_sqr(f2_add(z1, z2)), z1z1), z2z2), h
+    )
+    added = jnp.stack([x3, y3, z3], axis=-3)
+
+    doubled = g2_double(p)
+    p_inf = f2_is_zero(z1)
+    q_inf = f2_is_zero(z2)
+    out = added
+    ident = g2_identity(x1.shape[:-2])
+    out = jnp.where((same_x & ~same_y)[..., None, None, None], ident, out)
+    out = jnp.where((same_x & same_y)[..., None, None, None], doubled, out)
+    out = jnp.where(q_inf[..., None, None, None], p, out)
+    out = jnp.where(p_inf[..., None, None, None], q, out)
+    return out
+
+
+g2_add_jit = jax.jit(g2_add)
+g2_double_jit = jax.jit(g2_double)
+
+
+def g2_aggregate(points: jnp.ndarray) -> jnp.ndarray:
+    """Tree-reduce [B, 3, 2, 48] -> [3, 2, 48]: the device form of the
+    aggregate_public_keys point-add loop (bls_signatures.go:138-149 in
+    G2); log2(B) batched add levels, each level through the one jitted
+    g2_add per shape (same compile-bounding rationale as g1_aggregate)."""
+    b = points.shape[0]
+    nb = 1 << max(1, (b - 1).bit_length())
+    if nb != b:
+        pad = jnp.broadcast_to(
+            g2_identity(), (nb - b, 3, 2, NLIMBS)
+        ).astype(points.dtype)
+        points = jnp.concatenate([points, pad], axis=0)
+    while points.shape[0] > 1:
+        points = g2_add_jit(points[0::2], points[1::2])
+    return points[0]

@@ -46,6 +46,8 @@ _SLOW_MODULES = {
     "test_ops_field25519",
     "test_ops_sha",
     "test_ops_bls_g1",
+    "test_ops_bls_g2",
+    "test_ops_secp",
     "test_blocksync",
     "test_light",
     "test_statesync",

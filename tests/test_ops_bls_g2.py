@@ -1,0 +1,125 @@
+"""Device G2 kernel vs the host oracle (crypto/bls12_381.py).
+
+Same strategy as test_ops_bls_g1.py one tower level up: Fp2 arithmetic
+against python ints, the masked group law against the host Jacobian
+oracle on generic/equal/opposite/infinity inputs, and the aggregation
+tree against aggregate_public_keys' serial sum."""
+
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tendermint_tpu.crypto import bls12_381 as c
+from tendermint_tpu.ops import bls_g2 as k
+
+fe = k.fe
+P = k.P
+rng = random.Random(99)
+
+_f2mulc = jax.jit(lambda a, b: k.f2_canonical(k.f2_mul(a, b)))
+_f2sqrc = jax.jit(lambda a: k.f2_canonical(k.f2_sqr(a)))
+_f2subc = jax.jit(lambda a, b: k.f2_canonical(k.f2_sub(a, b)))
+
+
+def _rand_f2():
+    return (rng.randrange(P), rng.randrange(P))
+
+
+def _host_f2mul(a, b):
+    return c.f2_mul(a, b)
+
+
+def test_fp2_arithmetic_matches_host():
+    for _ in range(8):
+        a, b = _rand_f2(), _rand_f2()
+        ja = jnp.asarray(k.f2_from_host(a))
+        jb = jnp.asarray(k.f2_from_host(b))
+        got = k.f2_to_host(np.asarray(_f2mulc(ja, jb)))
+        assert got == c.f2_mul(a, b)
+        assert k.f2_to_host(np.asarray(_f2sqrc(ja))) == c.f2_mul(a, a)
+        assert k.f2_to_host(np.asarray(_f2subc(ja, jb))) == c.f2_sub(a, b)
+
+
+def test_fp48_field_worst_case_bounds():
+    """Worst-case bound stress for the make_field(P, 48) instance (the
+    vecfield docstring's per-instance pinning; the secp instance has its
+    own in test_ops_secp.py): all limbs at the loose bound through a mul
+    chain must keep the invariant and exact values."""
+    _mul48 = jax.jit(fe.mul)
+    _canon48 = jax.jit(fe.canonical)
+    worst = jnp.full((fe.NLIMBS,), (1 << 11) - 1, dtype=jnp.int32)
+    wv = fe.to_int(np.asarray(worst))
+    x = worst
+    val = wv
+    for _ in range(6):
+        x = _mul48(x, x)
+        val = val * val % P
+        assert int(np.asarray(x).max()) < (1 << 11), "loose bound violated"
+    assert fe.to_int(np.asarray(_canon48(x))) == val
+    # sub/neg at the bound (exercises the 128p BIAS construction)
+    _subc48 = jax.jit(lambda a, b: fe.canonical(fe.sub(a, b)))
+    z = jnp.zeros((fe.NLIMBS,), dtype=jnp.int32)
+    assert fe.to_int(np.asarray(_subc48(z, worst))) == (-wv) % P
+
+
+def test_g2_group_law_matches_host():
+    pts = [c.g2_mul(c.G2_GEN, rng.randrange(1, c.R)) for _ in range(4)]
+    affs = [c.g2_from_affine(c.g2_to_affine(p)) for p in pts]
+    for a in affs[:2]:
+        for b in affs[2:]:
+            ja = jnp.asarray(k.g2_from_host(a))
+            jb = jnp.asarray(k.g2_from_host(b))
+            got = k.g2_to_host(np.asarray(k.g2_add_jit(ja, jb)))
+            want_aff = c.g2_to_affine(c.g2_add(a, b))
+            got_aff = c.g2_to_affine(got)
+            assert got_aff == want_aff
+    # doubling two ways + identities + cancellation
+    a = affs[0]
+    ja = jnp.asarray(k.g2_from_host(a))
+    dbl_host = c.g2_to_affine(c.g2_double(a))
+    assert c.g2_to_affine(k.g2_to_host(np.asarray(k.g2_double_jit(ja)))) == dbl_host
+    assert c.g2_to_affine(k.g2_to_host(np.asarray(k.g2_add_jit(ja, ja)))) == dbl_host
+    inf = k.g2_identity(())
+    assert c.g2_to_affine(
+        k.g2_to_host(np.asarray(k.g2_add_jit(ja, inf)))
+    ) == c.g2_to_affine(a)
+    neg = c.g2_neg(a)
+    jn_ = jnp.asarray(k.g2_from_host(neg))
+    assert bool(np.asarray(jax.jit(k.g2_is_inf)(k.g2_add_jit(ja, jn_))))
+
+
+def test_aggregate_public_keys_device_path(monkeypatch):
+    """With the native library unavailable and N >= the device
+    threshold, aggregate_public_keys rides ops/bls_g2 and must agree
+    with the exact host loop (same preference-order contract as
+    aggregate_signatures)."""
+    from tendermint_tpu.crypto import bls_native, bls_signatures as bls
+
+    monkeypatch.setattr(bls_native, "native_lib", lambda build=True: None)
+    monkeypatch.setattr(bls, "DEVICE_AGGREGATE_MIN", 4)
+    # 13 keys -> pad 16: the same tree level shapes the aggregate test
+    # compiles, so this test adds no new XLA programs
+    pubs = [
+        bls.new_trusted_public_key(c.g2_mul(c.G2_GEN, 7 + i))
+        for i in range(13)
+    ]
+    got = bls.aggregate_public_keys(pubs)
+    acc = c.G2_INF
+    for pk in pubs:
+        acc = c.g2_add(acc, pk.key)
+    assert c.g2_to_affine(got.key) == c.g2_to_affine(acc)
+
+
+def test_g2_aggregate_matches_serial_sum():
+    n = 13  # odd, forces identity padding in the tree
+    pts = [c.g2_mul(c.G2_GEN, 1000 + i) for i in range(n)]
+    affs = [c.g2_from_affine(c.g2_to_affine(p)) for p in pts]
+    stack = jnp.asarray(np.stack([k.g2_from_host(p) for p in affs]))
+    got = k.g2_to_host(np.asarray(k.g2_aggregate(stack)))
+    acc = c.G2_INF
+    for p in affs:
+        acc = c.g2_add(acc, p)
+    assert c.g2_to_affine(got) == c.g2_to_affine(acc)

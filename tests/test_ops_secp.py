@@ -126,26 +126,6 @@ def test_group_ops_match_host_oracle():
 # --- full verify -----------------------------------------------------------
 
 
-def _prep(pub_pt, digest, sig64):
-    """The host-side half of the split: parse, low-S, u1/u2."""
-    r = int.from_bytes(sig64[:32], "big")
-    s = int.from_bytes(sig64[32:], "big")
-    ok = 1 <= r < host.N and 1 <= s <= host._HALF_N
-    if not ok:
-        return None
-    z = int.from_bytes(digest, "big") % host.N
-    si = pow(s, -1, host.N)
-    u1 = z * si % host.N
-    u2 = r * si % host.N
-    return (
-        fe.from_int(pub_pt[0]),
-        fe.from_int(pub_pt[1]),
-        np.frombuffer(u1.to_bytes(32, "big"), np.uint8),
-        np.frombuffer(u2.to_bytes(32, "big"), np.uint8),
-        np.frombuffer(sig64[:32], np.uint8),
-    )
-
-
 def test_verify_kernel_differential_via_batch_verifier(monkeypatch):
     """End to end through the BatchVerifier's TM_TPU_SECP_DEVICE route:
     host prep (parse/low-S/u1-u2/decompress) + device joint ladder must
@@ -215,3 +195,22 @@ def test_verify_wrapped_mod_n_guard():
     assert not bool(np.asarray(wrapped)[0] if np.ndim(wrapped) else wrapped), (
         "borrow guard failed: negative difference matched forged r"
     )
+    # positive side: x in [n, p) with r = x - n must take the wrapped
+    # branch (a break here would fail genuine x >= n signatures on the
+    # device only — a cross-backend consensus split no real signature
+    # would surface, P(x >= n) ~ 2^-128)
+    x_big = host.N + 12345
+    assert x_big < P
+    r_true = x_big - host.N
+    x_aff2 = jnp.asarray(fe.from_int(x_big))[None, :]
+    r_le2 = jnp.asarray(
+        np.frombuffer(r_true.to_bytes(32, "big"), np.uint8)[::-1].astype(
+            np.int32
+        )
+    )[None, :]
+    d2 = bool(np.asarray(jnp.all(x_aff2 == r_le2, axis=-1))[0])
+    xmn2, borrow2 = fe._scan_carry(x_aff2 - jnp.asarray(k._N_LIMBS))
+    w2 = (int(np.asarray(borrow2)[0]) == 0) and bool(
+        np.asarray(jnp.all(xmn2 == r_le2, axis=-1))[0]
+    )
+    assert not d2 and w2, "wrapped accept path broken for x >= n"
