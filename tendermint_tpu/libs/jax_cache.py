@@ -1,0 +1,35 @@
+"""Shared persistent XLA compile-cache environment setup.
+
+The crypto kernels are deep programs whose compiles dominate cold wall
+time; every entry point (bench, tests, the driver's multichip dryrun,
+node assembly) points jax's persistent cache at the same repo-local
+`.jax_cache` dir so compiles amortize across processes and rounds.
+Must run before the first `import jax` in the target process — jax reads
+these env vars at backend init (node/node.py additionally re-applies the
+dir via jax.config.update for post-import safety).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def set_compile_cache_env(env=None) -> None:
+    """Apply the cache settings to `env` (default: this process's environ).
+
+    Pass a plain dict to prepare a child-process environment instead.
+    Existing values are respected (setdefault) so operators can redirect
+    the cache without fighting the framework.
+    """
+    e = os.environ if env is None else env
+    e.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(repo_root(), ".jax_cache")
+    )
+    e.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    e.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")

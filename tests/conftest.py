@@ -20,14 +20,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 # Persistent compilation cache: the crypto kernels are deep programs and
 # CPU compiles dominate test wall time; cache across runs.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
-)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-os.environ.setdefault(
-    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1"
-)
+from tendermint_tpu.libs.jax_cache import set_compile_cache_env  # noqa: E402
+
+set_compile_cache_env()
 
 # node tests: skip the background validator-table warm thread — killing the
 # process mid-XLA-compile in a daemon thread aborts noisily at teardown
