@@ -133,7 +133,17 @@ def g2_from_bytes(b: bytes):
 
 
 def _pairing_is_one(pairs) -> bool:
-    """prod e(P_i, Q_i) == 1 — native when available."""
+    """prod e(P_i, Q_i) == 1 — three tiers: device kernel (gated for
+    real silicon, TM_TPU_BLS_PAIRING_DEVICE=1 — the PERF_ANALYSIS §6
+    pattern; closes SURVEY §7.3(2)'s "then move" half), native C++,
+    host bigints."""
+    if os.environ.get("TM_TPU_BLS_PAIRING_DEVICE") == "1":
+        try:
+            from ..ops import bls_pairing
+
+            return bls_pairing.check_pairs(pairs)
+        except Exception:
+            pass  # device unavailable mid-flight: fall through to host
     if native.native_lib() is not None:
         g1s = b"".join(g1_to_bytes(p) for p, _ in pairs)
         g2s = b"".join(g2_to_bytes(q) for _, q in pairs)

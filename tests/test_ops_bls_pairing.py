@@ -1,0 +1,156 @@
+"""Device BLS12-381 pairing kernel vs the host-validated curve library.
+
+The host module (crypto/bls12_381.py) is the correctness root — its own
+algebraic self-checks (bilinearity, subgroup orders, the final-exp
+decomposition assert at import) pin it; here every device stage must be
+BIT-EXACT against it, plus worst-case limb-bound stresses for the raw
+accumulation scheme (ops/bls_pairing.py module docstring).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tendermint_tpu.crypto import bls12_381 as h
+from tendermint_tpu.ops import bls_pairing as bp
+
+
+rng = random.Random(0xB15)
+
+
+def rf2():
+    return (rng.randrange(h.P), rng.randrange(h.P))
+
+
+def rf12():
+    return tuple(rf2() for _ in range(6))
+
+
+def runitary():
+    """A random element of the cyclotomic subgroup (easy part on host)."""
+    f = rf12()
+    u = h.f12_mul(h.f12_conj(f), h.f12_inv(f))
+    return h.f12_mul(h.f12_frob_n(u, 2), u)
+
+
+def test_f2_ops_match_host():
+    a, b = rf2(), rf2()
+    da = jnp.asarray(bp.f2_from_host(a))
+    db = jnp.asarray(bp.f2_from_host(b))
+
+    def out(x):
+        return bp.f2_to_host(np.asarray(bp.f2_canonical(x)))
+
+    assert out(bp.f2_mul(da, db)) == h.f2_mul(a, b)
+    assert out(bp.f2_sqr(da)) == h.f2_sqr(a)
+    assert out(bp.f2_add(da, db)) == h.f2_add(a, b)
+    assert out(bp.f2_sub(da, db)) == h.f2_sub(a, b)
+    assert out(bp.f2_mul_xi(da)) == h.f2_mul(a, h.XI)
+    assert out(bp.f2_inv(da)) == h.f2_inv(a)
+    assert out(bp.f2_conj(da)) == h.f2_conj(a)
+
+
+def test_f12_ops_match_host():
+    A, B = rf12(), rf12()
+    dA = jnp.asarray(bp.f12_from_host(A))
+    dB = jnp.asarray(bp.f12_from_host(B))
+    assert bp.f12_to_host(bp.f12_mul(dA, dB)) == h.f12_mul(A, B)
+    assert bp.f12_to_host(bp.f12_sqr(dA)) == h.f12_sqr(A)
+    assert bp.f12_to_host(bp.f12_inv(dA)) == h.f12_inv(A)
+    assert bp.f12_to_host(bp.f12_frob(dA)) == h.f12_frob(A)
+    assert bp.f12_to_host(bp.f12_conj(dA)) == h.f12_conj(A)
+
+
+def test_cyclo_sqr_matches_generic_on_unitary():
+    u = runitary()
+    du = jnp.asarray(bp.f12_from_host(u))
+    assert bp.f12_to_host(bp.f12_cyclo_sqr(du)) == h.f12_sqr(u)
+
+
+def test_f12_mul_worst_case_limb_bounds():
+    """The raw-accumulation discipline under adversarial inputs: every
+    limb at the loose-invariant max (2047). The product must still be
+    exactly right (no int32 overflow, no bias underflow in the xi-fold)
+    and the OUTPUT must remain a valid loose input (limbs small enough
+    to feed another mul/sub) — proven by squaring the output again."""
+    worst = np.full((6, 2, 48), 2047, dtype=np.int32)
+    A = tuple(
+        (bp.fe.to_int(worst[i, 0]) % h.P, bp.fe.to_int(worst[i, 1]) % h.P)
+        for i in range(6)
+    )
+    dA = jnp.asarray(worst)
+    got = bp.f12_mul(dA, dA)
+    assert bp.f12_to_host(got) == h.f12_mul(A, A)
+    limbs = np.asarray(got)
+    assert limbs.max() < 2048 and limbs.min() >= 0, (
+        f"f12_mul output limbs out of loose range: "
+        f"[{limbs.min()}, {limbs.max()}]"
+    )
+    # chainable: product-of-products still exact
+    AA = h.f12_mul(A, A)
+    assert bp.f12_to_host(bp.f12_sqr(got)) == h.f12_mul(AA, AA)
+
+
+def test_vecfield_matmul_conv_bit_exact():
+    """mul_style='matmul' is the same column sums as 'slices' — raw
+    outputs identical on random AND worst-case loose inputs."""
+    from tendermint_tpu.ops import vecfield
+
+    fs = vecfield.make_field(h.P, 48, mul_style="slices")
+    fm = vecfield.make_field(h.P, 48, mul_style="matmul")
+    cases = [
+        np.random.default_rng(5).integers(0, 2048, (4, 48), np.int32),
+        np.full((4, 48), 2047, dtype=np.int32),
+    ]
+    for a in cases:
+        b = a[::-1].copy()
+        out_s = np.asarray(fs.mul(jnp.asarray(a), jnp.asarray(b)))
+        out_m = np.asarray(fm.mul(jnp.asarray(a), jnp.asarray(b)))
+        assert (out_s == out_m).all()
+
+
+def test_device_pairing_matches_host():
+    """Full pipeline: miller (denominator-scaled Jacobian lines) + final
+    exp (GS cyclotomic) == host pairing (the cube of the optimal ate,
+    identical normalization)."""
+    p1 = h.g1_mul(h.G1_GEN, 7)
+    q1 = h.g2_mul(h.G2_GEN, 11)
+    assert bp.pairing_value([(p1, q1)]) == h.pairing(p1, q1)
+
+
+def test_device_pairing_bilinear():
+    """e(aP, Q) == e(P, aQ) computed entirely on device."""
+    a = 99991
+    va = bp.pairing_value([(h.g1_mul(h.G1_GEN, a), h.G2_GEN)])
+    vb = bp.pairing_value([(h.G1_GEN, h.g2_mul(h.G2_GEN, a))])
+    assert va == vb
+
+
+def test_device_check_pairs_accept_reject():
+    a = 123457
+    pa = h.g1_mul(h.G1_GEN, a)
+    qa = h.g2_mul(h.G2_GEN, a)
+    assert bp.check_pairs([(pa, h.G2_GEN), (h.g1_neg(h.G1_GEN), qa)])
+    assert not bp.check_pairs(
+        [(pa, h.G2_GEN), (h.g1_neg(h.G1_GEN), h.g2_mul(h.G2_GEN, a + 1))]
+    )
+    # infinity pairs contribute factor 1 (host miller_loop semantics)
+    assert bp.check_pairs([(h.G1_INF, h.G2_GEN)])
+
+
+def test_bls_verify_routes_through_device(monkeypatch):
+    """TM_TPU_BLS_PAIRING_DEVICE=1 routes the signature scheme's
+    2-pairing check through the kernel: good signature verifies, bad
+    rejects — the aggregate row end-to-end on device."""
+    from tendermint_tpu.crypto import bls_signatures as bls
+
+    monkeypatch.setenv("TM_TPU_BLS_PAIRING_DEVICE", "1")
+    sk = 0x42424242424242424242424242424242
+    pk = bls.pubkey_from_priv(sk)
+    msg = b"device-pairing-route"
+    sig = bls.sign(sk, msg)
+    assert bls.verify(sig, msg, pk)
+    assert not bls.verify(sig, msg + b"!", pk)
