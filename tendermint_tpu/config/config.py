@@ -183,6 +183,45 @@ class SequencerConfig:
 
 
 @dataclass
+class TpuConfig:
+    """Device-mesh parallelism (SURVEY §2.3): the batch axis of every
+    verification kernel shards over a jax.sharding.Mesh built from these
+    axes. ici_parallelism spans the chips of one host/slice (collectives
+    ride ICI); dcn_parallelism spans hosts (requires jax.distributed to
+    be initialized so jax.devices() is global). 1/1 (default) keeps the
+    single-device path; ici_parallelism=0 means "all local devices"."""
+
+    ici_parallelism: int = 1
+    dcn_parallelism: int = 1
+    # "" = the default jax backend; "cpu" = host virtual devices (tests /
+    # CI use 8 via --xla_force_host_platform_device_count)
+    mesh_backend: str = ""
+    # multi-host (DCN) runtime: when coordinator_address is set, node
+    # assembly calls jax.distributed.initialize(coordinator_address,
+    # num_processes, process_id) before any jax use, making
+    # jax.devices() global so the dcn mesh axis can span hosts
+    coordinator_address: str = ""  # host:port of process 0
+    num_processes: int = 1
+    process_id: int = 0
+
+    def validate_basic(self) -> None:
+        if self.ici_parallelism < 0:
+            raise ValueError("ici_parallelism must be >= 0")
+        if self.dcn_parallelism < 1:
+            raise ValueError("dcn_parallelism must be >= 1")
+        if self.num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        if not (0 <= self.process_id < self.num_processes):
+            raise ValueError("process_id must be in [0, num_processes)")
+        if self.dcn_parallelism > 1 and self.num_processes > 1:
+            if not self.coordinator_address:
+                raise ValueError(
+                    "dcn_parallelism over multiple processes needs "
+                    "coordinator_address"
+                )
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # kv | null
 
@@ -208,6 +247,7 @@ _SECTIONS = {
     "blocksync": BlockSyncConfig,
     "consensus": ConsensusTimeoutsConfig,
     "sequencer": SequencerConfig,
+    "tpu": TpuConfig,
     "tx_index": TxIndexConfig,
     "instrumentation": InstrumentationConfig,
 }
@@ -225,6 +265,7 @@ class Config:
         default_factory=ConsensusTimeoutsConfig
     )
     sequencer: SequencerConfig = field(default_factory=SequencerConfig)
+    tpu: TpuConfig = field(default_factory=TpuConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig

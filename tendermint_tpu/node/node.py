@@ -152,12 +152,18 @@ class Node(Service):
             # safe): the multiprocess testnets and every node on a host
             # amortize the same table-build/verify compiles. An explicit
             # JAX_COMPILATION_CACHE_DIR in the environment wins.
+            from ..crypto._native_build import _host_tag
+
+            # per-host-ISA subdir: XLA:CPU AOT entries embed host
+            # instructions; a cross-host entry on a shared dir is a
+            # SIGILL/segfault, not a cache miss (libs/jax_cache.py)
             cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or (
                 os.path.join(
                     os.path.expanduser("~"),
                     ".cache",
                     "tendermint_tpu",
                     "jax_cache",
+                    _host_tag(),
                 )
             )
             _jax.config.update("jax_compilation_cache_dir", cache_dir)
@@ -176,6 +182,40 @@ class Node(Service):
                 "TM_TPU_DEVICE_CHALLENGE_MIN",
                 str(config.base.device_challenge_min),
             )
+        # multi-host runtime: join the jax distributed service so
+        # jax.devices() is global and the dcn mesh axis can span hosts
+        # (the XLA-collective analog of the reference's cross-host NCCL/
+        # MPI plane; SURVEY §2.3 / §5 distributed comm backend)
+        if config.tpu.coordinator_address:
+            try:
+                import jax as _jax2
+
+                _jax2.distributed.initialize(
+                    coordinator_address=config.tpu.coordinator_address,
+                    num_processes=config.tpu.num_processes,
+                    process_id=config.tpu.process_id,
+                )
+            except Exception as e:
+                # a single-host deployment with a stale coordinator line
+                # must still boot — the mesh then covers local devices
+                self.logger.error(
+                    f"jax.distributed.initialize failed: {e}; "
+                    "continuing single-process"
+                )
+        # [tpu] mesh axes -> env, so the process-wide default_verifier()
+        # (constructed lazily by whichever reactor first verifies) builds
+        # the sharded verifier per config (parallel/mesh.py)
+        if config.tpu.ici_parallelism != 1 or config.tpu.dcn_parallelism != 1:
+            os.environ.setdefault(
+                "TM_TPU_ICI_PARALLELISM", str(config.tpu.ici_parallelism)
+            )
+            os.environ.setdefault(
+                "TM_TPU_DCN_PARALLELISM", str(config.tpu.dcn_parallelism)
+            )
+            if config.tpu.mesh_backend:
+                os.environ.setdefault(
+                    "TM_TPU_MESH_BACKEND", config.tpu.mesh_backend
+                )
         self.bls_key = bls.load_or_gen_bls_key(config.bls_key_file)
         self.bls_signer = bls.signer_for(
             bls.priv_key_from_bytes(self.bls_key.priv_key)

@@ -1,0 +1,10 @@
+"""Device-mesh construction and sharded-execution helpers (SURVEY §2.3).
+
+The kernels in ops/ are mesh-agnostic jittable functions; this package
+owns turning configuration into a `jax.sharding.Mesh` whose axes the
+BatchVerifier (and any other batch-sharded consumer) shards over.
+"""
+
+from .mesh import build_mesh, mesh_from_env
+
+__all__ = ["build_mesh", "mesh_from_env"]

@@ -1,0 +1,82 @@
+"""Build the verification mesh from config (SURVEY §2.3).
+
+The reference distributes work with one mechanism — goroutines over a
+host — so its only "parallelism config" is connection counts. Here the
+device plane is first-class: `[tpu] ici_parallelism` / `dcn_parallelism`
+pick how many chips the batch axis of every verification kernel shards
+over, and node assembly (node/node.py) exports them so the process-wide
+`default_verifier()` constructs a sharded verifier — a config change
+alone turns on multi-chip verification in a running node.
+
+Axis layout: a 1-axis `("batch",)` mesh for the single-host case; a
+2-axis `("dcn", "batch")` mesh when dcn_parallelism > 1, with device
+rows grouped by process index so the minor (batch) axis strides chips
+of one host — collectives along it ride ICI, and only the dcn-axis
+segments cross hosts. Consumers shard batches with
+`PartitionSpec(mesh.axis_names)` (all axes, major-to-minor), so the
+same spec works for both layouts.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def build_mesh(
+    ici_parallelism: int = 1,
+    dcn_parallelism: int = 1,
+    mesh_backend: str = "",
+):
+    """Mesh per the [tpu] config section, or None for the 1-device path.
+
+    ici_parallelism=0 means every visible device of the backend (divided
+    by dcn_parallelism when > 1). Raises if the device count cannot
+    satisfy the requested axes — a silently smaller mesh would hide a
+    deployment error.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices(mesh_backend or None)
+    ici = ici_parallelism
+    dcn = dcn_parallelism
+    if ici == 0:
+        ici = max(1, len(devs) // dcn)
+    if ici * dcn <= 1:
+        return None
+    if len(devs) < ici * dcn:
+        raise ValueError(
+            f"[tpu] mesh wants {ici}x{dcn} devices, backend "
+            f"{mesh_backend or 'default'} has {len(devs)}"
+        )
+    if dcn == 1:
+        return Mesh(np.array(devs[:ici]), ("batch",))
+    # group the dcn axis by process so the batch axis stays host-local
+    by_proc: dict[int, list] = {}
+    for d in devs:
+        by_proc.setdefault(d.process_index, []).append(d)
+    rows = []
+    if len(by_proc) >= dcn and all(
+        len(v) >= ici for v in list(by_proc.values())[:dcn]
+    ):
+        for proc in sorted(by_proc)[:dcn]:
+            rows.append(by_proc[proc][:ici])
+    else:  # single-process (tests): contiguous split keeps locality
+        flat = devs[: ici * dcn]
+        rows = [flat[i * ici : (i + 1) * ici] for i in range(dcn)]
+    return Mesh(np.array(rows), ("dcn", "batch"))
+
+
+def mesh_from_env():
+    """Mesh from TM_TPU_{ICI,DCN}_PARALLELISM / TM_TPU_MESH_BACKEND —
+    the env mirror of the [tpu] config section that node assembly
+    exports before the first default_verifier() call (same pattern as
+    TM_TPU_DEVICE_CHALLENGE_MIN)."""
+    ici = int(os.environ.get("TM_TPU_ICI_PARALLELISM", "1") or 1)
+    dcn = int(os.environ.get("TM_TPU_DCN_PARALLELISM", "1") or 1)
+    backend = os.environ.get("TM_TPU_MESH_BACKEND", "")
+    if ici == 1 and dcn == 1:
+        return None
+    return build_mesh(ici, dcn, backend)

@@ -395,3 +395,41 @@ def test_node_upgrade_switch_to_sequencer(tmp_path):
             await node.stop()
 
     asyncio.run(run())
+
+
+def test_tpu_config_section_roundtrip_and_validation(tmp_path):
+    """[tpu] mesh axes are first-class config (SURVEY §2.3): TOML
+    roundtrip + validate_basic constraints."""
+    cfg = make_test_config(tmp_path)
+    cfg.tpu.ici_parallelism = 8
+    cfg.tpu.dcn_parallelism = 2
+    cfg.tpu.mesh_backend = "cpu"
+    cfg.tpu.coordinator_address = "10.0.0.1:1234"
+    cfg.tpu.num_processes = 2
+    cfg.tpu.process_id = 1
+    cfg.validate_basic()
+    cfg.save()
+    loaded = Config.load(str(tmp_path))
+    assert loaded.tpu.ici_parallelism == 8
+    assert loaded.tpu.dcn_parallelism == 2
+    assert loaded.tpu.mesh_backend == "cpu"
+    assert loaded.tpu.coordinator_address == "10.0.0.1:1234"
+    assert loaded.tpu.num_processes == 2 and loaded.tpu.process_id == 1
+
+    import pytest as _pytest
+
+    bad = make_test_config(tmp_path)
+    bad.tpu.ici_parallelism = -1
+    with _pytest.raises(ValueError):
+        bad.tpu.validate_basic()
+    bad = make_test_config(tmp_path)
+    bad.tpu.dcn_parallelism = 2
+    bad.tpu.num_processes = 2
+    bad.tpu.coordinator_address = ""
+    with _pytest.raises(ValueError):
+        bad.tpu.validate_basic()
+    bad = make_test_config(tmp_path)
+    bad.tpu.num_processes = 2
+    bad.tpu.process_id = 2
+    with _pytest.raises(ValueError):
+        bad.tpu.validate_basic()
