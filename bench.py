@@ -152,6 +152,17 @@ def main() -> None:
         return
 
     import jax
+
+    # the tunnel sitecustomize imports jax before this file runs, so the
+    # cache env vars set at module top are dead letters there — pin the
+    # persistent-cache config post-import (same fix as node assembly)
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ["JAX_COMPILATION_CACHE_DIR"],
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
     import jax.numpy as jnp
 
     from tendermint_tpu.ops.ed25519_batch import (
@@ -413,6 +424,47 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
     except Exception as e:
         print(f"# light bisection metric failed: {e}", file=sys.stderr)
 
+    # --- light bisection at 1/10 of the BASELINE config-5 shape ----------
+    try:
+        rate, reqs, dt = _bench_light_bisection_1k()
+        out.append(
+            {
+                "metric": "light_bisection_1k",
+                "value": round(rate, 1),
+                "unit": (
+                    f"sigs/s (1024h x 1024v rotating chain, {reqs} light "
+                    f"blocks fetched, {dt:.1f} s)"
+                ),
+                "vs_baseline": round(rate / BASELINE_SERIAL_SIGS_PER_S, 3),
+            }
+        )
+    except Exception as e:
+        print(f"# light bisection 1k metric failed: {e}", file=sys.stderr)
+
+    # --- table-build cost per key: cold bulk warm vs cache hit -----------
+    try:
+        for m in _bench_table_build():
+            out.append(m)
+    except Exception as e:
+        print(f"# table build metric failed: {e}", file=sys.stderr)
+
+    # --- sustained throughput under validator-set churn ------------------
+    try:
+        rate, dt = _bench_churn_throughput()
+        out.append(
+            {
+                "metric": "ed25519_churn_throughput",
+                "value": round(rate, 1),
+                "unit": (
+                    "sigs/s (20 heights x 512 sigs, 25% key churn at "
+                    "height 11, warm+build inside the clock)"
+                ),
+                "vs_baseline": round(rate / BASELINE_SERIAL_SIGS_PER_S, 3),
+            }
+        )
+    except Exception as e:
+        print(f"# churn metric failed: {e}", file=sys.stderr)
+
     # --- vote-path latency through the micro-batcher ---------------------
     try:
         for m in _bench_vote_latency():
@@ -447,6 +499,234 @@ def _bench_light_bisection():
     # each verified light block costs one 128-signature commit verify
     n_sigs = requests * 128
     return n_sigs / dt, n_sigs, dt
+
+
+def _bench_table_build() -> list:
+    """Per-key cost of the fixed-window table build, cold vs cache hit
+    (VERDICT r4 weak #3: the generic tier matters exactly when tables
+    must be (re)built, and nothing priced that). Cold is a bulk warm of
+    128 fresh keys through BatchVerifier (including the one-time compile
+    only if this machine never built the bucket — the persistent cache
+    usually absorbs it); hit is the same warm again (a lock + dict pass,
+    no device work). vs_baseline compares against ONE serial-CPU verify
+    (~65 us): the factor says how many reference verifies one build
+    costs, i.e. the reuse count where the table pays for itself."""
+    from tendermint_tpu.crypto import ed25519 as hosted
+    from tendermint_tpu.crypto.batch_verifier import BatchVerifier
+
+    pubs = [
+        hosted.PrivKey.from_secret(b"warmkey%d" % i).public_key().data
+        for i in range(128)
+    ]
+    v = BatchVerifier(min_device_batch=0, bigtable_min=8)
+    t0 = time.perf_counter()
+    v.warm(pubs, bulk=True)
+    cold_ms = (time.perf_counter() - t0) * 1e3 / 128
+    t0 = time.perf_counter()
+    v.warm(pubs, bulk=True)
+    hit_ms = (time.perf_counter() - t0) * 1e3 / 128
+    serial_ms = 1e3 / BASELINE_SERIAL_SIGS_PER_S
+    return [
+        {
+            "metric": "ed25519_table_build_cold_per_key",
+            "value": round(cold_ms, 3),
+            "unit": "ms/key (128-key bulk warm)",
+            "vs_baseline": round(serial_ms / cold_ms, 5) if cold_ms else 0.0,
+        },
+        {
+            "metric": "ed25519_table_build_hit_per_key",
+            "value": round(hit_ms, 4),
+            "unit": "ms/key (re-warm of cached keys)",
+            "vs_baseline": round(serial_ms / hit_ms, 2) if hit_ms else 0.0,
+        },
+    ]
+
+
+def _bench_churn_throughput():
+    """Sustained verification across a validator-set rotation: 20
+    heights x 512 sigs over 128 validators, 25% of the keys replaced at
+    height 11 (the scenario where PERF_ANALYSIS §4's 'churn is bounded'
+    claim actually bites — table builds and generic-tier work land
+    INSIDE the measured window). Host-side signing is prepared outside
+    the clock; warms and verifies are inside."""
+    from tendermint_tpu.crypto import ed25519 as hosted
+    from tendermint_tpu.crypto.batch_verifier import BatchVerifier, SigItem
+
+    nv, heights, per_h = 128, 20, 512
+    keys = [hosted.PrivKey.from_secret(b"churn0-%d" % i) for i in range(nv)]
+    eras = {1: list(keys)}
+    rotated = list(keys)
+    for i in range(nv // 4):  # 25% churn
+        rotated[i] = hosted.PrivKey.from_secret(b"churn1-%d" % i)
+    eras[11] = rotated
+
+    batches = {}
+    active = eras[1]
+    pubs = {id(k): k.public_key().data for k in set(eras[1] + eras[11])}
+    for h in range(1, heights + 1):
+        active = eras.get(h, active)
+        items = []
+        for i in range(per_h):
+            k = active[i % nv]
+            msg = b"churn-vote-%d-%d" % (h, i)
+            items.append(SigItem(pubs[id(k)], msg, k.sign(msg)))
+        batches[h] = items
+
+    v = BatchVerifier(min_device_batch=0, bigtable_min=8)
+    active = eras[1]
+    t0 = time.perf_counter()
+    for h in range(1, heights + 1):
+        if h in eras:
+            active = eras[h]
+            v.warm([pubs[id(k)] for k in active], bulk=True)
+        out = np.asarray(v.verify(batches[h]))
+        assert out.all(), f"churn bench verify failed at height {h}"
+    dt = time.perf_counter() - t0
+    return heights * per_h / dt, dt
+
+
+def _make_lazy_light_chain(n_heights, n_vals, rotate_every):
+    """A light-block chain generated ON DEMAND — the BASELINE config-5
+    shape (reference light/client.go:706-775 bisection over distant
+    headers) without materializing n_heights x n_vals host signatures:
+    bisection touches O(log H) heights, so only those are signed.
+
+    The validator set rotates 50% at every `rotate_every` boundary in
+    two alternating halves, so sets two regions apart share NO keys:
+    a direct trust-period jump past two boundaries fails the 1/3
+    overlap rule and the client must bisect into every region — the
+    log-bisection x 2-commit shape the bench is after."""
+    from tests.test_light import BLOCK_NS, CHAIN_ID as LCID, T0
+    from tendermint_tpu.light import LightBlock
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.types.block_id import BlockID
+    from tendermint_tpu.types.part_set import PartSetHeader
+    from tendermint_tpu.types.priv_validator import MockPV
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+    from tendermint_tpu.types.vote import Vote, VoteType
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    pv_cache: dict = {}
+    set_cache: dict = {}
+    block_cache: dict = {}
+
+    def pv_for(i: int, generation: int):
+        key = (i, generation)
+        if key not in pv_cache:
+            pv_cache[key] = MockPV.from_secret(b"lazy-%d-%d" % key)
+        return pv_cache[key]
+
+    def vals(region: int):
+        if region not in set_cache:
+            pvs = []
+            for i in range(n_vals):
+                group = (2 * i) // n_vals  # two alternating halves
+                generation = sum(
+                    1 for s in range(1, region + 1) if s % 2 == group % 2
+                )
+                pvs.append(pv_for(i, generation))
+            vs = ValidatorSet(
+                [Validator(pv.get_pub_key(), 10) for pv in pvs]
+            )
+            by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+            ordered = [by_addr[v.address] for v in vs.validators]
+            set_cache[region] = (vs, ordered)
+        return set_cache[region]
+
+    def block(h: int):
+        if h in block_cache:
+            return block_cache[h]
+        region = (h - 1) // rotate_every
+        region_next = min(h, n_heights - 1) // rotate_every
+        vs, ordered = vals(region)
+        vs_next, _ = vals(region_next)
+        header = Header(
+            chain_id=LCID,
+            height=h,
+            time_ns=T0 + h * BLOCK_NS,
+            last_block_id=BlockID(),
+            validators_hash=vs.hash(),
+            next_validators_hash=vs_next.hash(),
+            app_hash=b"lazy-app-%d" % h,
+            proposer_address=vs.validators[0].address,
+        )
+        bid = BlockID(header.hash(), PartSetHeader(1, header.hash()))
+        votes = VoteSet(LCID, h, 0, VoteType.PRECOMMIT, vs)
+        for i, pv in enumerate(ordered):
+            v = Vote(
+                type=VoteType.PRECOMMIT,
+                height=h,
+                round=0,
+                block_id=bid,
+                timestamp_ns=header.time_ns,
+                validator_address=pv.get_pub_key().address(),
+                validator_index=i,
+            )
+            pv.sign_vote(LCID, v)
+            votes.add_vote(v, verified=True)
+        lb = LightBlock(header, votes.make_commit(), vs)
+        block_cache[h] = lb
+        return lb
+
+    return block
+
+
+class _LazyProvider:
+    def __init__(self, block_fn, latest: int, name="primary"):
+        self.block_fn = block_fn
+        self.latest = latest
+        self.name = name
+        self.requests: list = []
+
+    async def light_block(self, height: int):
+        if height == 0:
+            height = self.latest
+        self.requests.append(height)
+        return self.block_fn(height)
+
+    def id(self):
+        return self.name
+
+
+def _bench_light_bisection_1k(
+    n_heights: int = 1024, n_vals: int = 1024, rotate_every: int = 128
+):
+    """Bisection at 1/10 the BASELINE config-5 scale (VERDICT r4 weak
+    #4: the 32x128 metric priced two dispatch floors, not amortization).
+    Forces the small-table tier (bigtable_min=inf) so the measurement is
+    the bisection's batched commit verifies, not 8 GiB of fixed-window
+    table builds. Returns (sigs/s, light-blocks fetched, seconds)."""
+    import asyncio
+
+    from tests.test_light import CHAIN_ID as LCID, PERIOD, T0, BLOCK_NS
+    from tendermint_tpu.crypto import batch_verifier as bv
+    from tendermint_tpu.light import LightClient, TrustOptions
+    from tendermint_tpu.light.store import LightStore
+    from tendermint_tpu.store.kv import MemKV
+
+    block_fn = _make_lazy_light_chain(n_heights, n_vals, rotate_every)
+    primary = _LazyProvider(block_fn, n_heights)
+    witness = _LazyProvider(block_fn, n_heights, name="witness-0")
+    client = LightClient(
+        LCID,
+        TrustOptions(PERIOD, 1, block_fn(1).header.hash()),
+        primary,
+        [witness],
+        LightStore(MemKV()),
+        now_ns=lambda: T0 + (n_heights + 10) * BLOCK_NS,
+    )
+    saved = bv._default
+    bv._default = bv.BatchVerifier(min_device_batch=0, bigtable_min=1 << 30)
+    try:
+        t0 = time.perf_counter()
+        lb = asyncio.run(client.verify_light_block_at_height(n_heights))
+        dt = time.perf_counter() - t0
+    finally:
+        bv._default = saved
+    assert lb.height == n_heights
+    n_sigs = len(primary.requests) * n_vals
+    return n_sigs / dt, len(primary.requests), dt
 
 
 def _bench_vote_latency():

@@ -20,16 +20,29 @@ def repo_root() -> str:
     )
 
 
+def cache_dir() -> str:
+    """Per-host-ISA cache dir: XLA:CPU AOT entries embed host-specific
+    instructions (the loader itself warns 'could lead to execution
+    errors such as SIGILL' on feature mismatch — and a stale cross-host
+    entry segfaulted a real test run), so the dir is keyed by the same
+    CPU fingerprint the native .so builds use."""
+    from ..crypto._native_build import _host_tag
+
+    return os.path.join(repo_root(), ".jax_cache", _host_tag())
+
+
 def set_compile_cache_env(env=None) -> None:
     """Apply the cache settings to `env` (default: this process's environ).
 
     Pass a plain dict to prepare a child-process environment instead.
     Existing values are respected (setdefault) so operators can redirect
-    the cache without fighting the framework.
+    the cache without fighting the framework. NOTE: if jax was already
+    imported when this runs (the tunnel sitecustomize does so at
+    interpreter start), these env vars are dead letters — callers in
+    that position must also jax.config.update(...) (see tests/conftest,
+    bench.py, node assembly).
     """
     e = os.environ if env is None else env
-    e.setdefault(
-        "JAX_COMPILATION_CACHE_DIR", os.path.join(repo_root(), ".jax_cache")
-    )
+    e.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir())
     e.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     e.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")

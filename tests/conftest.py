@@ -9,8 +9,27 @@ process, hence this happens at conftest import time.
 
 import os
 
-# Hard-force: the outer environment may set JAX_PLATFORMS=axon (the TPU
-# tunnel); tests must be hermetic on the virtual CPU mesh.
+# Neutralize the tunnel's PJRT plugin BEFORE any backend init: the
+# .axon_site sitecustomize imports jax and registers the axon backend at
+# interpreter startup; while the tunnel endpoint is down, initializing
+# that backend hangs every jax.devices() — even when tests only want CPU
+# (round-4/5 outage mode: ~25 min hang, then "Unable to initialize
+# backend"). Tests are hermetic on the virtual CPU mesh by design, so
+# drop the factory from the registry; the suite then runs identically
+# with the tunnel up, down, or absent.
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+# Hard-force the CPU platform and the 8-device count. The env vars alone
+# are DEAD LETTERS here: sitecustomize already imported jax, and jax
+# snapshots env-derived config at import — so pin everything that has a
+# config knob via jax.config.update too. XLA_FLAGS is still read from
+# the environment at backend creation (which has not happened yet), so
+# setting it here remains effective.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -23,6 +42,15 @@ if "xla_force_host_platform_device_count" not in flags:
 from tendermint_tpu.libs.jax_cache import set_compile_cache_env  # noqa: E402
 
 set_compile_cache_env()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 # node tests: skip the background validator-table warm thread — killing the
 # process mid-XLA-compile in a daemon thread aborts noisily at teardown
@@ -42,6 +70,7 @@ _SLOW_MODULES = {
     "test_ops_sha",
     "test_ops_bls_g1",
     "test_ops_bls_g2",
+    "test_ops_bls_pairing",
     "test_ops_secp",
     "test_blocksync",
     "test_light",
