@@ -332,42 +332,12 @@ mul_jit = jax.jit(mul)
 canonical_jit = jax.jit(canonical)
 
 
-_SHARDED_AGG: dict = {}
-
-
 def g1_aggregate_sharded(points, mesh) -> jnp.ndarray:
-    """Tree-reduce [B, 3, 48] -> replicated [3, 48] under a device mesh:
-    the batch axis shards over every mesh axis and XLA inserts the ICI
-    collectives as the halving tree narrows below the shard count
-    (SURVEY §2.3 — the aggregation analog of the sharded verify). B is
-    padded to a power of two; for small B the whole tree is one program
-    (log2(B) inlined add levels), traded against the per-level dispatch
-    of g1_aggregate because the mesh path exists for bulk shapes."""
-    import jax as _jax
-    from jax.sharding import NamedSharding, PartitionSpec as _P
+    """Point sum over a device mesh: local tree per shard + an explicit
+    XOR-butterfly ppermute all-reduce with g1_add as the combiner (see
+    ops/shard_reduce.py for why shard_map, not jit-with-shardings)."""
+    from . import shard_reduce
 
-    b = points.shape[0]
-    nb = 1 << max(1, (b - 1).bit_length())
-    pts = np.asarray(points)
-    if nb != b:
-        pad = np.broadcast_to(
-            np.asarray(g1_identity()), (nb - b, 3, NLIMBS)
-        ).astype(pts.dtype)
-        pts = np.concatenate([pts, pad], axis=0)
-    sh = NamedSharding(mesh, _P(mesh.axis_names))
-    key = (mesh, nb)
-    fn = _SHARDED_AGG.get(key)
-    if fn is None:
-
-        def reduce_all(p):
-            while p.shape[0] > 1:
-                p = g1_add(p[0::2], p[1::2])
-            return p[0]
-
-        fn = _jax.jit(
-            reduce_all,
-            in_shardings=(sh,),
-            out_shardings=NamedSharding(mesh, _P()),
-        )
-        _SHARDED_AGG[key] = fn
-    return fn(_jax.device_put(pts, sh))
+    return shard_reduce.aggregate_sharded(
+        points, mesh, g1_add, np.asarray(g1_identity()), (3, NLIMBS)
+    )

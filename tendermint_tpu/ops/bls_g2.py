@@ -199,41 +199,16 @@ g2_add_jit = jax.jit(g2_add)
 g2_double_jit = jax.jit(g2_double)
 
 
-_SHARDED_AGG: dict = {}
-
-
 def g2_aggregate_sharded(points, mesh) -> jnp.ndarray:
-    """Mesh-sharded tree-reduce [B, 3, 2, 48] -> replicated [3, 2, 48]
-    (the G2/pubkey twin of ops/bls_g1.g1_aggregate_sharded; same
-    rationale and shape discipline)."""
-    import jax as _jax
-    from jax.sharding import NamedSharding, PartitionSpec as _P
+    """Point sum over a device mesh (G2/pubkey twin of
+    ops/bls_g1.g1_aggregate_sharded): local tree per shard + an
+    XOR-butterfly ppermute all-reduce with g2_add as the combiner —
+    see ops/shard_reduce.py."""
+    from . import shard_reduce
 
-    b = points.shape[0]
-    nb = 1 << max(1, (b - 1).bit_length())
-    pts = np.asarray(points)
-    if nb != b:
-        pad = np.broadcast_to(
-            np.asarray(g2_identity()), (nb - b, 3, 2, NLIMBS)
-        ).astype(pts.dtype)
-        pts = np.concatenate([pts, pad], axis=0)
-    sh = NamedSharding(mesh, _P(mesh.axis_names))
-    key = (mesh, nb)
-    fn = _SHARDED_AGG.get(key)
-    if fn is None:
-
-        def reduce_all(p):
-            while p.shape[0] > 1:
-                p = g2_add(p[0::2], p[1::2])
-            return p[0]
-
-        fn = _jax.jit(
-            reduce_all,
-            in_shardings=(sh,),
-            out_shardings=NamedSharding(mesh, _P()),
-        )
-        _SHARDED_AGG[key] = fn
-    return fn(_jax.device_put(pts, sh))
+    return shard_reduce.aggregate_sharded(
+        points, mesh, g2_add, np.asarray(g2_identity()), (3, 2, NLIMBS)
+    )
 
 
 def g2_aggregate(points: jnp.ndarray) -> jnp.ndarray:
