@@ -429,6 +429,50 @@ def cmd_probe_upnp(args) -> int:
     return 0
 
 
+def cmd_probe_tpu(args) -> int:
+    """Show the device plane as the node would see it: backend, device
+    inventory, and the mesh the [tpu] config section resolves to —
+    the operator's first stop when sharded verification doesn't engage."""
+    from .config import Config
+
+    cfg = Config.load(args.home)
+    t = cfg.tpu
+    print(
+        f"[tpu] ici_parallelism={t.ici_parallelism} "
+        f"dcn_parallelism={t.dcn_parallelism} "
+        f"mesh_backend={t.mesh_backend or '(default)'}"
+    )
+    import jax
+
+    try:
+        devs = jax.devices(t.mesh_backend or None)
+    except Exception as e:
+        print(f"backend unavailable: {e}")
+        return 1
+    print(f"backend: {jax.default_backend()}, {len(devs)} device(s)")
+    for d in devs[:16]:
+        print(f"  {d.id}: {d.device_kind} (process {d.process_index})")
+    if len(devs) > 16:
+        print(f"  ... and {len(devs) - 16} more")
+    from .parallel import build_mesh
+
+    try:
+        mesh = build_mesh(
+            t.ici_parallelism, t.dcn_parallelism, t.mesh_backend
+        )
+    except ValueError as e:
+        print(f"mesh: UNSATISFIABLE ({e})")
+        return 1
+    if mesh is None:
+        print("mesh: none (single-device verification path)")
+    else:
+        print(
+            f"mesh: axes {dict(mesh.shape)} -> batch dim shards over "
+            f"{mesh.devices.size} devices"
+        )
+    return 0
+
+
 def cmd_version(args) -> int:
     print(
         f"tendermint-tpu {TMCORE_SEM_VER} "
@@ -542,6 +586,11 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("probe-upnp", help="probe for a UPnP gateway")
     sp.set_defaults(fn=cmd_probe_upnp)
+
+    sp = sub.add_parser(
+        "probe-tpu", help="show devices + the [tpu] config mesh"
+    )
+    sp.set_defaults(fn=cmd_probe_tpu)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)
