@@ -51,15 +51,22 @@ class CanonicalVoteEncoder:
     compatibility checks."""
 
     @staticmethod
-    def vote(
+    def vote_parts(
         msg_type: int,
         height: int,
         round_: int,
         block_id_bytes: bytes,
-        timestamp_ns: int,
         chain_id: str,
-    ) -> bytes:
-        body = b"".join(
+    ) -> tuple[bytes, bytes]:
+        """(prefix, suffix) of the canonical vote body around its only
+        per-signer field — the timestamp (field 5):
+        vote(...) == marshal_delimited(prefix + field_message(5,
+        encode_timestamp(ts)) + suffix). Exposed so batch commit
+        verification can encode O(validators) sign-bytes per commit
+        without re-encoding the shared fields (types/block.py caches
+        these parts per commit); `vote` below composes the same parts,
+        keeping one source of truth for the layout."""
+        prefix = b"".join(
             [
                 pio.field_varint(1, msg_type),
                 pio.field_sfixed64(2, height),
@@ -69,11 +76,38 @@ class CanonicalVoteEncoder:
                     if block_id_bytes
                     else b""
                 ),
-                pio.field_message(5, encode_timestamp(timestamp_ns)),
-                pio.field_bytes(6, chain_id.encode()),
             ]
         )
-        return pio.marshal_delimited(body)
+        return prefix, pio.field_bytes(6, chain_id.encode())
+
+    @staticmethod
+    def vote_from_parts(
+        prefix: bytes, suffix: bytes, timestamp_ns: int
+    ) -> bytes:
+        """Assemble the final sign-bytes from vote_parts output — the
+        ONLY place the timestamp field number and the delimited framing
+        live, so cached-parts callers cannot drift from `vote`."""
+        return pio.marshal_delimited(
+            prefix
+            + pio.field_message(5, encode_timestamp(timestamp_ns))
+            + suffix
+        )
+
+    @staticmethod
+    def vote(
+        msg_type: int,
+        height: int,
+        round_: int,
+        block_id_bytes: bytes,
+        timestamp_ns: int,
+        chain_id: str,
+    ) -> bytes:
+        prefix, suffix = CanonicalVoteEncoder.vote_parts(
+            msg_type, height, round_, block_id_bytes, chain_id
+        )
+        return CanonicalVoteEncoder.vote_from_parts(
+            prefix, suffix, timestamp_ns
+        )
 
     @staticmethod
     def proposal(

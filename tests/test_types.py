@@ -382,6 +382,42 @@ def test_verify_commit_shape_checks():
         small.verify_commit_light(CHAIN_ID, bid, 3, commit)
 
 
+def test_vote_sign_bytes_matches_canonical_encoder():
+    """The cached-parts fast path in Commit.vote_sign_bytes must stay
+    byte-identical to a direct CanonicalVoteEncoder.vote encode for every
+    signature variant (commit bid, nil bid, distinct timestamps) and
+    across chain ids (the cache is keyed on both)."""
+    from tendermint_tpu.types import canonical
+
+    vs, pvs = make_valset(4)
+    bid = make_block_id()
+    commit = make_commit_for(vs, pvs, 3, bid, nil_indices=(2,))
+    # make the timestamps visibly distinct (incl. a 0-nanos boundary)
+    commit.signatures[0].timestamp_ns = 1_700_000_000_000_000_000
+    commit.signatures[1].timestamp_ns = 1_700_000_001_000_000_000
+    commit.signatures[2].timestamp_ns = 1_700_000_002_500_000_000
+    for chain_id in (CHAIN_ID, "other-chain"):
+        for i, cs in enumerate(commit.signatures):
+            if cs.is_absent():
+                continue
+            sbid = cs.block_id(commit.block_id)
+            want = canonical.CanonicalVoteEncoder.vote(
+                canonical.PRECOMMIT_TYPE,
+                commit.height,
+                commit.round,
+                canonical.canonical_block_id(
+                    sbid.hash,
+                    sbid.part_set_header.total,
+                    sbid.part_set_header.hash,
+                ),
+                cs.timestamp_ns,
+                chain_id,
+            )
+            assert commit.vote_sign_bytes(chain_id, i) == want, (
+                f"sign-bytes diverged for sig {i} on {chain_id}"
+            )
+
+
 # --- genesis / params -----------------------------------------------------
 
 
