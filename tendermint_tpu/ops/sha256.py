@@ -59,8 +59,62 @@ def _rotr(x, n):
     return (x >> n) | (x << (32 - n))
 
 
+def _compress_scan(state, block_words):
+    """Scan-form compression (see ops/sha512._use_scan_rounds: the
+    straight-line body is right for the TPU executor but hour-class to
+    compile on a 1-core XLA:CPU box). Bit-exact with _compress."""
+
+    def sched_step(win, _):
+        # win: [..., 16], index 0 == w[i-16]
+        s0 = _rotr(win[..., 1], 7) ^ _rotr(win[..., 1], 18) ^ (
+            win[..., 1] >> 3
+        )
+        s1 = _rotr(win[..., 14], 17) ^ _rotr(win[..., 14], 19) ^ (
+            win[..., 14] >> 10
+        )
+        nw = win[..., 0] + s0 + win[..., 9] + s1
+        return (
+            jnp.concatenate([win[..., 1:], nw[..., None]], axis=-1),
+            nw,
+        )
+
+    _, ext = jax.lax.scan(sched_step, block_words, None, length=48)
+    ws = jnp.concatenate(
+        [jnp.moveaxis(block_words, -1, 0), ext], axis=0
+    )  # [64, ...]
+
+    def round_step(regs, x):
+        a, b, c, d, e, f, g, h = regs
+        w_i, k_i = x
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k_i + w_i
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    regs0 = tuple(state[..., i] for i in range(8))
+    outs, _ = jax.lax.scan(round_step, regs0, (ws, jnp.asarray(_K)))
+    return state + jnp.stack(outs, axis=-1)
+
+
+def _use_scan_rounds() -> bool:
+    """Same backend/env heuristic as ops/sha512._use_scan_rounds (see
+    its docstring for the measured rationale); defined locally so the
+    two hash modules stay import-independent."""
+    import os
+
+    forced = os.environ.get("TM_TPU_SHA_SCAN")
+    if forced is not None:
+        return forced == "1"
+    return jax.default_backend() == "cpu"
+
+
 def _compress(state, block_words):
     """state: [..., 8] u32; block_words: [..., 16] u32 -> [..., 8] u32."""
+    if _use_scan_rounds():
+        return _compress_scan(state, block_words)
     # message schedule
     w = [block_words[..., i] for i in range(16)]
     for i in range(16, 64):

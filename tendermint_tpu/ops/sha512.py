@@ -93,8 +93,109 @@ def _xor3(a, b, c):
     return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
 
 
+def _use_scan_rounds() -> bool:
+    """Pick the compression-loop structure by backend at trace time.
+
+    The straight-line 80-round body is right for the TPU executor
+    (PERF_ANALYSIS §1: deep fused elementwise chains are ~free there,
+    while loop iterations are billed per iteration). But the XLA:CPU
+    pipeline on a 1-core CI box takes HOURS on the ~5k-op unrolled body
+    (measured r5: `challenge_batch` alone exceeded 15 min of compile;
+    the fused verify program exceeded 100 min — vs seconds for the scan
+    form). Identical uint32 math either way; tests/test_ops_sha pins
+    the active form against hashlib, tests/test_ops_sha pins the two
+    forms against each other in eager mode, and the TPU form is
+    exercised by every bench/production run on the chip.
+
+    TM_TPU_SHA_SCAN=0/1 overrides the backend heuristic — the heuristic
+    reads the PROCESS-wide default backend, so hashing pinned to CPU on
+    a TPU host (e.g. under jax.default_device) would otherwise pick the
+    unrolled body and hit the slow CPU compile."""
+    import os
+
+    forced = os.environ.get("TM_TPU_SHA_SCAN")
+    if forced is not None:
+        return forced == "1"
+    return jax.default_backend() == "cpu"
+
+
+def _compress512_scan(sh, sl, wh, wl):
+    """Scan-form SHA-512 compression (see _use_scan_rounds). Bit-exact
+    with _compress512: same schedule recurrence and round function,
+    expressed as two lax.scans (~60-op bodies) instead of straight-line
+    code."""
+    # message schedule: roll a 16-word window, emitting w16..w79
+    def sched_step(win, _):
+        h16, l16 = win  # [..., 16] each; index 0 == w[i-16]
+        s0 = _xor3(
+            _rotr64(h16[..., 1], l16[..., 1], 1),
+            _rotr64(h16[..., 1], l16[..., 1], 8),
+            _shr64(h16[..., 1], l16[..., 1], 7),
+        )
+        s1 = _xor3(
+            _rotr64(h16[..., 14], l16[..., 14], 19),
+            _rotr64(h16[..., 14], l16[..., 14], 61),
+            _shr64(h16[..., 14], l16[..., 14], 6),
+        )
+        h, l = _add64(h16[..., 0], l16[..., 0], s0[0], s0[1])
+        h, l = _add64(h, l, h16[..., 9], l16[..., 9])
+        h, l = _add64(h, l, s1[0], s1[1])
+        nwh = jnp.concatenate([h16[..., 1:], h[..., None]], axis=-1)
+        nwl = jnp.concatenate([l16[..., 1:], l[..., None]], axis=-1)
+        return (nwh, nwl), (h, l)
+
+    _, (eh, el) = jax.lax.scan(sched_step, (wh, wl), None, length=64)
+    # full 80-word schedule on a leading axis: [80, ...]
+    ws_h = jnp.concatenate([jnp.moveaxis(wh, -1, 0), eh], axis=0)
+    ws_l = jnp.concatenate([jnp.moveaxis(wl, -1, 0), el], axis=0)
+
+    def round_step(regs, x):
+        a, b, c, d, e, f, g, hh = regs
+        w_h, w_l, k_h, k_l = x
+        s1 = _xor3(_rotr64(*e, 14), _rotr64(*e, 18), _rotr64(*e, 41))
+        ch = (
+            (e[0] & f[0]) ^ (~e[0] & g[0]),
+            (e[1] & f[1]) ^ (~e[1] & g[1]),
+        )
+        t1 = _add64(*hh, *s1)
+        t1 = _add64(*t1, *ch)
+        t1 = _add64(*t1, k_h, k_l)
+        t1 = _add64(*t1, w_h, w_l)
+        s0 = _xor3(_rotr64(*a, 28), _rotr64(*a, 34), _rotr64(*a, 39))
+        maj = (
+            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+        )
+        t2 = _add64(*s0, *maj)
+        return (
+            _add64(*t1, *t2),
+            a,
+            b,
+            c,
+            _add64(*d, *t1),
+            e,
+            f,
+            g,
+        ), None
+
+    regs0 = tuple((sh[..., i], sl[..., i]) for i in range(8))
+    xs = (ws_h, ws_l, jnp.asarray(_KH), jnp.asarray(_KL))
+    outs, _ = jax.lax.scan(round_step, regs0, xs)
+    oh = jnp.stack(
+        [_add64(*outs[i], sh[..., i], sl[..., i])[0] for i in range(8)],
+        axis=-1,
+    )
+    ol = jnp.stack(
+        [_add64(*outs[i], sh[..., i], sl[..., i])[1] for i in range(8)],
+        axis=-1,
+    )
+    return oh, ol
+
+
 def _compress512(sh, sl, wh, wl):
     """One SHA-512 compression. sh/sl: [..., 8]; wh/wl: [..., 16]."""
+    if _use_scan_rounds():
+        return _compress512_scan(sh, sl, wh, wl)
     whs = [wh[..., i] for i in range(16)]
     wls = [wl[..., i] for i in range(16)]
     for i in range(16, 80):

@@ -110,3 +110,30 @@ def test_device_merkle_production_route_matches_host(monkeypatch):
     # the leaf kernel really is what ran for the big case
     leaves = merkle._device_leaf_hashes(cases[2])
     assert leaves == [merkle.leaf_hash(x) for x in cases[2]]
+
+
+def test_scan_and_unrolled_compression_agree(monkeypatch):
+    """The two compression forms (scan for CPU compile tractability,
+    straight-line for the TPU executor) must be bit-exact. Run both in
+    EAGER mode — op-by-op dispatch, no XLA program build — so CI never
+    pays the unrolled form's hour-class CPU compile."""
+    rng = np.random.default_rng(7)
+    st512 = jnp.asarray(rng.integers(0, 1 << 32, (3, 8), dtype=np.uint32))
+    sl512 = jnp.asarray(rng.integers(0, 1 << 32, (3, 8), dtype=np.uint32))
+    wh = jnp.asarray(rng.integers(0, 1 << 32, (3, 16), dtype=np.uint32))
+    wl = jnp.asarray(rng.integers(0, 1 << 32, (3, 16), dtype=np.uint32))
+
+    monkeypatch.setenv("TM_TPU_SHA_SCAN", "0")
+    uh, ul = dsha512._compress512(st512, sl512, wh, wl)
+    monkeypatch.setenv("TM_TPU_SHA_SCAN", "1")
+    sh, sl = dsha512._compress512(st512, sl512, wh, wl)
+    np.testing.assert_array_equal(np.asarray(uh), np.asarray(sh))
+    np.testing.assert_array_equal(np.asarray(ul), np.asarray(sl))
+
+    st256 = jnp.asarray(rng.integers(0, 1 << 32, (3, 8), dtype=np.uint32))
+    blk = jnp.asarray(rng.integers(0, 1 << 32, (3, 16), dtype=np.uint32))
+    monkeypatch.setenv("TM_TPU_SHA_SCAN", "0")
+    u256 = dsha256._compress(st256, blk)
+    monkeypatch.setenv("TM_TPU_SHA_SCAN", "1")
+    s256 = dsha256._compress(st256, blk)
+    np.testing.assert_array_equal(np.asarray(u256), np.asarray(s256))
