@@ -51,9 +51,19 @@ def _host_tag() -> str:
 
 
 def _src_hash(src: str) -> Optional[str]:
+    """Hash of the translation unit: the .cpp plus every native/*.h it
+    could include (generated asm headers live there) — a header edit
+    must invalidate the cached .so just like a .cpp edit."""
     try:
+        h = hashlib.sha256()
         with open(src, "rb") as f:
-            return hashlib.sha256(f.read()).hexdigest()
+            h.update(f.read())
+        import glob
+
+        for hdr in sorted(glob.glob(os.path.join(os.path.dirname(src), "*.h"))):
+            with open(hdr, "rb") as f:
+                h.update(f.read())
+        return h.hexdigest()
     except OSError:
         return None
 
