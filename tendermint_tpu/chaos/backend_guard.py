@@ -1,0 +1,149 @@
+"""Backend-outage detection and graceful degradation for perf capture.
+
+Round-4 failure mode: with the axon tunnel endpoint dead, ANY `import
+jax` in a process whose PYTHONPATH carries the tunnel's plugin site hangs
+forever in PJRT plugin discovery — `bench.py` produced rc=124/rc=1
+artifacts (a traceback tail after a 25-minute hang) instead of data.
+
+This module bounds the damage: `probe_backend` initializes jax in a
+SUBPROCESS with a hard timeout and classifies the outcome, so drivers can
+(a) skip or (b) fall back to a CPU capture, and always emit a structured
+`{"rc","error","backend","fallback"}` JSON artifact.
+
+Env knobs:
+  TM_TPU_BACKEND_GUARD_TIMEOUT  probe bound in seconds (default 120)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Optional
+
+# stderr markers that mean "infrastructure outage", not a code regression
+_TUNNEL_MARKERS = ("Unable to initialize backend", "axon", "libtpu")
+
+DEFAULT_PROBE_TIMEOUT = float(os.environ.get("TM_TPU_BACKEND_GUARD_TIMEOUT", "120"))
+
+
+@dataclass
+class BackendStatus:
+    available: bool
+    backend: Optional[str] = None  # platform name when available
+    rc: int = 0  # probe subprocess return code (124 = timeout)
+    error: str = ""  # classified failure detail
+    kind: str = "ok"  # ok | tunnel_down | timeout | backend_error
+
+    def as_dict(self) -> dict:
+        return {
+            "available": self.available,
+            "backend": self.backend,
+            "rc": self.rc,
+            "error": self.error,
+            "kind": self.kind,
+        }
+
+
+def sanitized_env(
+    base: Optional[dict] = None, platform: Optional[str] = None
+) -> dict:
+    """Environment with the tunnel's jax plugin site stripped from
+    PYTHONPATH (its discovery is what hangs when the endpoint is down),
+    optionally pinned to a platform via JAX_PLATFORMS."""
+
+    def is_tunnel_path(p: str) -> bool:
+        return any(
+            seg.startswith(".axon") or seg in ("axon_site", "axon")
+            for seg in p.split(os.sep)
+        )
+
+    env = dict(base if base is not None else os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not is_tunnel_path(p)
+    )
+    if platform is not None:
+        env["JAX_PLATFORMS"] = platform
+    return env
+
+
+def classify_failure(stderr: str, rc: int) -> str:
+    if rc == 124:
+        return "timeout"
+    if any(m in stderr for m in _TUNNEL_MARKERS):
+        return "tunnel_down"
+    return "backend_error"
+
+
+def probe_backend(
+    platform: Optional[str] = None,
+    timeout_s: float = DEFAULT_PROBE_TIMEOUT,
+    env: Optional[dict] = None,
+    probe_cmd: Optional[list[str]] = None,
+) -> BackendStatus:
+    """Initialize jax in a bounded-time child and report what happened.
+
+    `platform=None` probes whatever backend the ambient environment
+    selects (the TPU tunnel in the perf harness); `platform="cpu"` probes
+    the sanitized CPU fallback. `probe_cmd` overrides the child command
+    (tests inject hang/failure behaviors without touching jax).
+    """
+    cmd = probe_cmd or [
+        sys.executable,
+        "-c",
+        "import jax; print(jax.default_backend())",
+    ]
+    child_env = env
+    if child_env is None:
+        child_env = (
+            sanitized_env(platform=platform) if platform else dict(os.environ)
+        )
+    try:
+        proc = subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=child_env,
+        )
+    except subprocess.TimeoutExpired:
+        return BackendStatus(
+            available=False,
+            rc=124,
+            error=f"jax init exceeded {timeout_s:.0f}s (hang)",
+            kind="timeout",
+        )
+    if proc.returncode == 0 and proc.stdout.strip():
+        return BackendStatus(
+            available=True, backend=proc.stdout.strip().splitlines()[-1]
+        )
+    reason = proc.stderr.strip()[-800:] or f"rc={proc.returncode}"
+    return BackendStatus(
+        available=False,
+        rc=proc.returncode,
+        error=reason,
+        kind=classify_failure(proc.stderr, proc.returncode),
+    )
+
+
+def fallback_artifact(
+    status: BackendStatus,
+    fallback: str = "none",
+    extra: Optional[dict] = None,
+) -> dict:
+    """The structured artifact shape every guarded capture emits on
+    degradation: always parseable, never a raw traceback tail."""
+    out = {
+        "rc": status.rc,
+        "error": status.error,
+        "backend": status.backend,
+        "fallback": fallback,
+        "kind": status.kind,
+        "ok": status.available,
+    }
+    if extra:
+        out.update(extra)
+    return out

@@ -12,7 +12,11 @@ instrumentation (:1057), plus the morph-specific [sequencer] knobs
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11
+    import tomli as tomllib
 from dataclasses import asdict, dataclass, field, fields
 from typing import Optional
 

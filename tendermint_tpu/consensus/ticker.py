@@ -23,13 +23,23 @@ class TimeoutInfo:
 
 
 class TimeoutTicker:
-    def __init__(self):
+    def __init__(self, scale: float = 1.0):
         self._out: asyncio.Queue[TimeoutInfo] = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # clock skew: every scheduled duration is multiplied by this —
+        # chaos scenarios skew a node's timeout clock (>1 = slow ticker,
+        # <1 = eager) to model drifting local clocks without touching
+        # the consensus state machine (chaos/scenario.py "clock_skew")
+        self._scale = scale
 
     @property
     def tock_queue(self) -> asyncio.Queue:
         return self._out
+
+    def set_scale(self, scale: float) -> None:
+        if scale <= 0:
+            raise ValueError("ticker scale must be positive")
+        self._scale = scale
 
     def schedule(self, ti: TimeoutInfo) -> None:
         """Replaces any pending timeout (the reference stops the old timer
@@ -40,7 +50,7 @@ class TimeoutTicker:
 
     async def _fire(self, ti: TimeoutInfo) -> None:
         try:
-            await asyncio.sleep(ti.duration_s)
+            await asyncio.sleep(ti.duration_s * self._scale)
             self._out.put_nowait(ti)
         except asyncio.CancelledError:
             pass

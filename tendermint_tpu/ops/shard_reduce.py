@@ -30,6 +30,18 @@ try:  # jax >= 0.4.35 exposes it at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+# the "don't verify replication" kwarg was renamed check_rep -> check_vma
+import inspect
+
+try:
+    _CHECK_KW = (
+        "check_vma"
+        if "check_vma" in inspect.signature(_shard_map).parameters
+        else "check_rep"
+    )
+except (TypeError, ValueError):  # pragma: no cover
+    _CHECK_KW = "check_rep"
+
 _CACHE: dict = {}
 
 
@@ -81,7 +93,7 @@ def aggregate_sharded(points, mesh, add_fn, identity, trailing_shape):
                 mesh=mesh,
                 in_specs=spec,
                 out_specs=spec,
-                check_vma=False,
+                **{_CHECK_KW: False},
             )
         )
         _CACHE[key] = fn

@@ -8,13 +8,28 @@ each received message to the reactor that claimed its channel.
 from __future__ import annotations
 
 import asyncio
-from typing import Optional
+import random
+from typing import Callable, Optional
 
+from ..libs.events import EventSwitch
 from ..libs.log import Logger, nop_logger
 from ..libs.service import Service
 from .mconn import ChannelDescriptor, MConnection
 from .node_info import NodeInfo
 from .transport import MultiplexTransport, NetAddress, Peer
+
+# redial schedule (reference switch.go reconnectAttempts): FULL-jitter
+# exponential backoff — sleep ~ U(0, min(CAP, BASE·2ⁿ)). The seed's fixed
+# 0.2s·2ⁿ schedule redialed simultaneously-restarted nodes in lockstep
+# (thundering herd); jitter decorrelates them. Exhausting the attempt cap
+# fires a terminal "gave up" event; non-persistent dials then stop, while
+# persistent peers drop to a slow lane (jittered sleeps at the cap) so an
+# extended outage never permanently degrades the mesh.
+DIAL_BACKOFF_BASE = 0.2
+DIAL_BACKOFF_CAP = 10.0
+MAX_DIAL_ATTEMPTS = 40
+
+EVENT_PEER_DIAL_GAVE_UP = "peer_dial_gave_up"
 
 
 class Reactor:
@@ -51,6 +66,8 @@ class Switch(Service):
         max_peers: int = 50,
         send_rate: int = 0,
         recv_rate: int = 0,
+        max_dial_attempts: int = MAX_DIAL_ATTEMPTS,
+        dial_rng: Optional[random.Random] = None,
     ):
         super().__init__("p2p-switch", logger)
         self.transport = transport
@@ -65,6 +82,20 @@ class Switch(Service):
         self.recv_rate = recv_rate
         self.dialing: set[str] = set()
         self._persistent_addrs: list[NetAddress] = []
+        # addresses with a live _dial_with_retry loop (including its
+        # backoff sleeps, which `dialing` does not cover) — keeps error
+        # redials and heal()-triggered redial_persistent() from stacking
+        # concurrent retry loops for one address
+        self._retrying: set[str] = set()
+        self.max_dial_attempts = max_dial_attempts
+        # seedable so chaos scenarios replay the exact redial schedule
+        self.dial_rng = dial_rng or random.Random()
+        # peer lifecycle events (EVENT_PEER_DIAL_GAVE_UP fires with the
+        # NetAddress after the redial budget is exhausted)
+        self.events = EventSwitch()
+        # chaos seam: predicate(peer_id) -> bool consulted before a peer
+        # is added; partitions/blackholes install one (chaos/network.py)
+        self.conn_gate: Optional[Callable[[str], bool]] = None
 
     def add_reactor(self, name: str, reactor: Reactor) -> None:
         for ch in reactor.get_channels():
@@ -119,10 +150,38 @@ class Switch(Service):
         if persistent:
             self._persistent_addrs.extend(addrs)
         for addr in addrs:
-            self.spawn(self._dial_with_retry(addr), f"dial/{addr}")
+            self.spawn(
+                self._dial_with_retry(addr, persistent=persistent),
+                f"dial/{addr}",
+            )
 
-    async def _dial_with_retry(self, addr: NetAddress) -> None:
-        backoff = 0.2
+    async def _dial_with_retry(
+        self,
+        addr: NetAddress,
+        initial_backoff: bool = False,
+        persistent: bool = False,
+    ) -> None:
+        key = addr.id or str(addr)
+        if key in self._retrying:
+            return
+        self._retrying.add(key)
+        try:
+            await self._dial_with_retry_locked(addr, initial_backoff, persistent)
+        finally:
+            self._retrying.discard(key)
+
+    async def _dial_with_retry_locked(
+        self, addr: NetAddress, initial_backoff: bool, persistent: bool
+    ) -> None:
+        attempt = 0
+        if initial_backoff:
+            # error-path redials: the dial itself may SUCCEED and then be
+            # reset by the remote (e.g. its conn_gate rejects us), which
+            # never reaches the failure backoff below — desynchronize the
+            # first attempt so such loops can't spin at full speed
+            await asyncio.sleep(
+                self.dial_rng.uniform(0.0, 2 * DIAL_BACKOFF_BASE)
+            )
         while self.is_running:
             try:
                 peer = await self.dial_peer(addr)
@@ -130,8 +189,40 @@ class Switch(Service):
                     return
             except Exception as e:
                 self.logger.info("dial failed", addr=str(addr), err=repr(e))
-            await asyncio.sleep(backoff)
-            backoff = min(backoff * 2, 10.0)
+            attempt += 1
+            if attempt == self.max_dial_attempts:
+                self.logger.info(
+                    "giving up on peer",
+                    addr=str(addr),
+                    attempts=attempt,
+                    persistent=persistent,
+                )
+                self.events.fire_event(EVENT_PEER_DIAL_GAVE_UP, addr)
+                # non-persistent dials are done; persistent peers drop to
+                # a slow lane (jittered sleeps at the cap) instead of
+                # being abandoned forever — a peer down for 10 minutes
+                # must not permanently degrade the mesh
+                if not persistent:
+                    return
+            ceiling = min(
+                DIAL_BACKOFF_CAP, DIAL_BACKOFF_BASE * (2 ** min(attempt, 16))
+            )
+            await asyncio.sleep(self.dial_rng.uniform(0.0, ceiling))
+
+    def redial_persistent(self) -> None:
+        """Re-kick the retry loop for persistent peers not currently
+        connected, dialing, or already inside a retry loop — after a
+        partition heals, peers without a live retry loop (e.g. dropped
+        gracefully by the partition enforcer) reconnect through here."""
+        for addr in self._persistent_addrs:
+            if addr.id and (addr.id in self.peers or addr.id in self.dialing):
+                continue
+            if (addr.id or str(addr)) in self._retrying:
+                continue
+            self.spawn(
+                self._dial_with_retry(addr, persistent=True),
+                f"redial/{addr}",
+            )
 
     # --- peers ------------------------------------------------------------
 
@@ -140,12 +231,34 @@ class Switch(Service):
     ) -> Peer:
         my_info = self.transport._node_info_fn()
         my_info.compatible_with(info)
+        if self.conn_gate is not None and not self.conn_gate(info.node_id):
+            sconn.close()
+            raise ValueError(f"connection to {info.node_id[:12]} blackholed")
         if info.node_id == my_info.node_id:
             sconn.close()
             raise ValueError("connected to self")
-        if info.node_id in self.peers:
-            sconn.close()
-            raise ValueError("duplicate peer")
+        existing = self.peers.get(info.node_id)
+        if existing is not None:
+            # simultaneous-dial crossing: both ends dialed each other at
+            # once. If each side kept its own outbound conn, each would
+            # close the conn the OTHER side kept — both die and the
+            # instant redial re-crosses, a reconnect livelock (seen after
+            # partition heal, when every node redials at the same tick).
+            # Tie-break so both sides keep the SAME conn: the one dialed
+            # by the lower node id survives.
+            lower_is_me = my_info.node_id < info.node_id
+            new_survives = outbound == lower_is_me
+            existing_survives = existing.outbound == lower_is_me
+            if existing_survives or not new_survives:
+                sconn.close()
+                raise ValueError("duplicate peer")
+            await self._stop_and_remove(existing, "crossed dial: replaced")
+            if info.node_id in self.peers:
+                # another add for this id completed during the await —
+                # inserting now would silently overwrite a live peer and
+                # leak it as a running ghost in every reactor
+                sconn.close()
+                raise ValueError("duplicate peer")
 
         descs = [
             d
@@ -183,20 +296,29 @@ class Switch(Service):
     async def stop_peer_for_error(self, peer: Peer, reason: str) -> None:
         """StopPeerForError (reference :opped by every reactor on bad
         messages); persistent peers get redialed."""
-        if peer.id not in self.peers:
+        # identity check, not membership: after a crossed-dial replacement
+        # the dead conn's error callback fires while self.peers[id] maps
+        # to the REPLACEMENT peer, which must stay up
+        if self.peers.get(peer.id) is not peer:
             return
         self.logger.info("stopping peer", peer=str(peer), reason=reason)
         await self._stop_and_remove(peer, reason)
         for addr in self._persistent_addrs:
             if addr.id == peer.id and self.is_running:
-                self.spawn(self._dial_with_retry(addr), f"redial/{addr}")
+                self.spawn(
+                    self._dial_with_retry(
+                        addr, initial_backoff=True, persistent=True
+                    ),
+                    f"redial/{addr}",
+                )
                 break
 
     async def stop_peer_gracefully(self, peer: Peer) -> None:
         await self._stop_and_remove(peer, "graceful stop")
 
     async def _stop_and_remove(self, peer: Peer, reason: str) -> None:
-        self.peers.pop(peer.id, None)
+        if self.peers.get(peer.id) is peer:
+            del self.peers[peer.id]
         await peer.stop()
         for r in self.reactors.values():
             await r.remove_peer(peer, reason)

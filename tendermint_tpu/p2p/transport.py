@@ -80,6 +80,7 @@ class MultiplexTransport:
         node_key: NodeKey,
         node_info_fn: Callable[[], NodeInfo],
         logger: Optional[Logger] = None,
+        conn_wrapper: Optional[Callable] = None,
     ):
         self._node_key = node_key
         self._node_info_fn = node_info_fn
@@ -87,6 +88,10 @@ class MultiplexTransport:
         self._server: Optional[asyncio.AbstractServer] = None
         self._accepted: asyncio.Queue = asyncio.Queue()
         self.listen_port = 0
+        # (peer_id, conn) -> conn: interposition seam for link shaping —
+        # chaos wraps every upgraded connection here so ALL reactor
+        # traffic is shaped without reactor changes (chaos/link.py)
+        self.conn_wrapper = conn_wrapper
 
     async def listen(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._server = await asyncio.start_server(self._on_accept, host, port)
@@ -137,9 +142,12 @@ class MultiplexTransport:
         if auth_id != their_info.node_id:
             raise ValueError("node id does not match authenticated key")
         peername = writer.get_extra_info("peername") or ("?", 0)
+        conn = sconn
+        if self.conn_wrapper is not None:
+            conn = self.conn_wrapper(their_info.node_id, sconn)
         return (
             their_info,
-            sconn,
+            conn,
             NetAddress(their_info.node_id, peername[0], peername[1]),
         )
 

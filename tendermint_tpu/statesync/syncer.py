@@ -49,6 +49,14 @@ class _RejectSender(SyncError):
 
 CHUNK_FETCH_TIMEOUT = 10.0
 CHUNK_REQUEST_FANOUT = 4
+# a peer whose chunks keep failing is dropped from the snapshot's pool
+# after this many strikes — the statesync mirror of the blocksync
+# request-timeout ban (blocksync/pool.py _timeout_peer)
+CHUNK_PEER_MAX_FAILURES = 3
+# how many CHUNK_FETCH_TIMEOUT expiries to ride through (rotating the
+# stalled chunk to another peer each time) before giving up on the
+# snapshot — one silent peer must not sink an otherwise healthy pool
+CHUNK_FETCH_MAX_TIMEOUTS = 4
 
 
 @dataclass
@@ -230,15 +238,36 @@ class Syncer:
     async def _fetch_chunks(
         self, d: _DiscoveredSnapshot, chunks: ChunkQueue
     ) -> None:
-        """Request chunk allocations from peers round-robin (:411)."""
+        """Request chunk allocations from peers round-robin (:411),
+        rotating a retried chunk away from the peer whose copy failed."""
         next_peer = 0
+        failures: dict[str, int] = {}
         while not chunks.complete:
             index = chunks.allocate()
             if index is None:
                 await asyncio.sleep(0.05)
                 continue
-            peer = d.peers[next_peer % len(d.peers)]
+            avoid = chunks.last_sender(index)
+            if avoid:
+                # one strike per failed fetch, charged to the peer whose
+                # copy failed (NOT the chunk's cumulative retry count —
+                # that would charge every earlier peer's failure to
+                # whichever peer failed last)
+                failures[avoid] = failures.get(avoid, 0) + 1
+                if failures[avoid] >= CHUNK_PEER_MAX_FAILURES and len(
+                    d.peers
+                ) > 1:
+                    d.peers = [p for p in d.peers if p.id != avoid]
+                    self.logger.info(
+                        "dropping failing statesync peer", peer=avoid
+                    )
+            candidates = [
+                p for p in d.peers if p.id not in self._rejected_peers
+            ] or d.peers
+            pool = [p for p in candidates if p.id != avoid] or candidates
+            peer = pool[next_peer % len(pool)]
             next_peer += 1
+            chunks.note_request(index, peer.id)
             self._request_chunk(
                 peer, d.snapshot.height, d.snapshot.format, index
             )
@@ -249,11 +278,23 @@ class Syncer:
     ) -> None:
         """Apply in order, honoring the app's retry/reject verdicts (:354)."""
         applied = 0
+        timeouts = 0
         while applied < chunks.num_chunks:
             chunk = chunks.get(applied)
             if chunk is None:
                 if not await chunks.wait_for_chunk(CHUNK_FETCH_TIMEOUT):
-                    raise asyncio.TimeoutError("chunk fetch timed out")
+                    # the peer holding the next needed chunk went silent:
+                    # put the chunk back for refetch (charged to the peer
+                    # note_request recorded) so the fetcher rotates to
+                    # another peer, instead of one dead peer sinking the
+                    # whole snapshot
+                    timeouts += 1
+                    if timeouts > CHUNK_FETCH_MAX_TIMEOUTS:
+                        raise asyncio.TimeoutError("chunk fetch timed out")
+                    self.logger.info(
+                        "chunk fetch timed out; rotating", chunk=applied
+                    )
+                    chunks.retry(applied)
                 continue
             res = self._app.apply_snapshot_chunk(
                 chunk.index, chunk.chunk, chunk.sender
@@ -266,14 +307,14 @@ class Syncer:
                 if sender:
                     self._rejected_peers.add(sender)
                     for idx in chunks.discard_sender(sender):
-                        chunks.retry(idx)
+                        chunks.retry(idx, sender)
             result = res.result
             if result == "ACCEPT":
                 applied += 1
             elif result == "ABORT":
                 raise ErrAbort()
             elif result == "RETRY":
-                chunks.retry(chunk.index)
+                chunks.retry(chunk.index, chunk.sender)
             elif result == "RETRY_SNAPSHOT":
                 raise _RetrySnapshot()
             elif result == "REJECT_SNAPSHOT":

@@ -1,0 +1,123 @@
+"""Chaos-mesh builder shared by tests/test_chaos.py and tools/soak.py.
+
+Builds in-proc validator nodes over real encrypted p2p (the same shape as
+test_consensus_reactor.build_p2p_node) wrapped in `chaos.NodeHandle`s,
+with a restart_fn that rebuilds transport/switch around the surviving
+consensus state — the "restart" scenario action.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.chaos import NodeHandle
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.transport import MultiplexTransport, NetAddress
+
+from tests.helpers import make_genesis, make_validators
+from tests.test_consensus import make_node
+
+NETWORK = "chaos-chain"
+
+
+def _wire_node(cs, nk):
+    """Fresh transport + switch + consensus reactor for one node."""
+    transport = None
+    sw = None
+
+    def node_info():
+        return NodeInfo(
+            node_id=nk.id,
+            listen_addr=f"127.0.0.1:{transport.listen_port}",
+            network=NETWORK,
+            channels=sw.channels() if sw else b"",
+        )
+
+    transport = MultiplexTransport(nk, node_info)
+    sw = Switch(transport)
+    sw.add_reactor("consensus", ConsensusReactor(cs))
+    return transport, sw
+
+
+def build_chaos_handles(n: int = 4) -> list[NodeHandle]:
+    """n validator NodeHandles (not yet listening/started)."""
+    vs, pvs = make_validators(n)
+    genesis = make_genesis(vs)
+    handles: list[NodeHandle] = []
+    for i, pv in enumerate(pvs):
+        cs, app, l2, bs, ss = make_node(vs, pv, genesis)
+        nk = NodeKey.generate()
+        transport, sw = _wire_node(cs, nk)
+        handles.append(
+            NodeHandle(
+                name=f"n{i}",
+                cs=cs,
+                node_key=nk,
+                transport=transport,
+                switch=sw,
+                block_store=bs,
+                restart_fn=_make_restart(handles),
+            )
+        )
+    return handles
+
+
+def _make_restart(handles: list[NodeHandle]):
+    async def restart(handle: NodeHandle, net) -> None:
+        """Rebuild p2p around the same consensus state (restart
+        semantics: same privval + stores, fresh node key) and rejoin."""
+        handle.node_key = NodeKey.generate()
+        handle.transport, handle.switch = _wire_node(handle.cs, handle.node_key)
+        net.install(handle)
+        await handle.transport.listen()
+        await handle.switch.start()
+        handle.switch.dial_peers_async(
+            [
+                NetAddress(h.node_key.id, "127.0.0.1", h.transport.listen_port)
+                for h in handles
+                if h is not handle and h.alive
+            ],
+            persistent=True,
+        )
+        await handle.cs.start()
+
+    return restart
+
+
+async def start_mesh(handles: list[NodeHandle]) -> None:
+    """Listen, start switches, wire a persistent full mesh, start
+    consensus. Chaos must already be installed (ScenarioRunner/
+    ChaosNetwork.install) so transports wrap their connections."""
+    for h in handles:
+        await h.transport.listen()
+        await h.switch.start()
+    for h in handles:
+        h.switch.dial_peers_async(
+            [
+                NetAddress(o.node_key.id, "127.0.0.1", o.transport.listen_port)
+                for o in handles
+                if o is not h
+            ],
+            persistent=True,
+        )
+    for h in handles:
+        await h.cs.start()
+
+
+async def stop_mesh(handles: list[NodeHandle]) -> None:
+    for h in handles:
+        if not h.alive:
+            continue
+        await h.cs.stop()
+        await h.switch.stop()
+
+
+async def chain_hashes(handles: list[NodeHandle], height: int) -> set:
+    return {
+        h.block_store.load_block(height).hash()
+        for h in handles
+        if h.alive and h.block_store.height >= height
+    }

@@ -113,11 +113,19 @@ async def run_load(
         seq = 0
         try:
             target = node.consensus.state.last_block_height + blocks
+            # one burst per committed height: block cadence varies wildly
+            # across machines, so pacing by wall clock makes the number
+            # of committed txs (and the report) timing-dependent — pacing
+            # by height guarantees >= (blocks-1)*rate txs land in blocks
+            injected_at = None
             while node.consensus.state.last_block_height < target:
-                burst = [make_tx(seq + i, tx_size) for i in range(rate)]
-                seq += rate
-                l2.inject_txs(burst)
-                await asyncio.sleep(0.1)
+                h = node.consensus.state.last_block_height
+                if h != injected_at:
+                    burst = [make_tx(seq + i, tx_size) for i in range(rate)]
+                    seq += rate
+                    l2.inject_txs(burst)
+                    injected_at = h
+                await asyncio.sleep(0.02)
             return report_from_store(node.block_store)
         finally:
             await node.stop()

@@ -1,0 +1,67 @@
+"""Multichip dryrun capture that ALWAYS emits one parseable JSON artifact.
+
+The round-4 MULTICHIP artifact was `{"rc": 124, "tail": "<traceback>"}` —
+the driver timed out waiting on a jax init that hung on a dead tunnel
+endpoint. This wrapper runs `__graft_entry__.dryrun_multichip(n)` (which
+already sandboxes the mesh body in a sanitized subprocess) and prints one
+structured line:
+
+    {"n_devices", "rc", "ok", "error", "backend", "fallback", "elapsed_s"}
+
+exit code is always 0: infrastructure state lives IN the artifact, so the
+driver never has to scrape tracebacks again.
+
+Usage: python tools/multichip_capture.py [n_devices]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(n_devices: int) -> dict:
+    """Run the sharded dryrun and build the artifact dict (no printing,
+    no exits — unit-testable)."""
+    from tendermint_tpu.chaos.backend_guard import classify_failure
+
+    t0 = time.perf_counter()
+    try:
+        from __graft_entry__ import dryrun_multichip
+
+        dryrun_multichip(n_devices)
+        return {
+            "n_devices": n_devices,
+            "rc": 0,
+            "ok": True,
+            "error": "",
+            "backend": "cpu",  # the dryrun pins the sanitized CPU mesh
+            "fallback": "none",
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+        }
+    except BaseException as e:  # noqa: BLE001 - artifact must always emit
+        msg = str(e)[-1200:]
+        rc = 124 if "exceeded" in msg else 1
+        return {
+            "n_devices": n_devices,
+            "rc": rc,
+            "ok": False,
+            "error": msg,
+            "backend": None,
+            "fallback": "none",
+            "kind": classify_failure(msg, rc),
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+        }
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    print(json.dumps(capture(n)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Chaos soak loop — randomized seeded scenarios for a bounded wall-clock.
+
+Each iteration draws a scenario from `chaos.random_scenario(seed, ...)`
+(partition/heal or blackhole/heal plus a latency/drop storm, all derived
+from the seed), runs it on a fresh in-proc 4-validator mesh, and checks
+that every live node reconverges on ONE chain at the target height. On
+any divergence/stall the loop STOPS and dumps the failing seed plus the
+resolved plan trace, so the failure replays locally with:
+
+    TM_TPU_CHAOS_SEED=<seed> python tools/soak.py --iters 1
+
+Usage:
+    python tools/soak.py [--budget SECONDS] [--iters N] [--nodes N]
+                         [--height H] [--seed S]
+
+Exit code: 0 if every completed iteration converged, 1 on the first
+divergence (artifact JSON on stdout either way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.chaos import ScenarioRunner, random_scenario
+from tendermint_tpu.chaos.scenario import default_seed
+
+
+async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
+    from tests.chaos_harness import (
+        build_chaos_handles,
+        chain_hashes,
+        start_mesh,
+        stop_mesh,
+    )
+
+    handles = build_chaos_handles(n_nodes)
+    scenario = random_scenario(seed, [h.name for h in handles])
+    runner = ScenarioRunner(handles, scenario)
+    await start_mesh(handles)
+    try:
+        heights = await runner.run(until_height=height, timeout=timeout)
+        hashes = await chain_hashes(handles, height - 1)
+        converged = len(hashes) == 1 and all(
+            seq[:height] == list(range(1, height + 1))
+            for name, seq in heights.items()
+            if runner.nodes[name].alive
+        )
+        return {
+            "seed": seed,
+            "ok": converged,
+            "heights": {k: (v[-1] if v else 0) for k, v in heights.items()},
+            "forks": len(hashes),
+            "plan": runner.plan_jsonl().decode(),
+        }
+    except TimeoutError as e:
+        return {
+            "seed": seed,
+            "ok": False,
+            "error": str(e),
+            "plan": runner.plan_jsonl().decode(),
+        }
+    finally:
+        await stop_mesh(handles)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", type=float, default=300.0,
+                    help="wall-clock budget in seconds (default 300)")
+    ap.add_argument("--iters", type=int, default=0,
+                    help="max iterations (0 = budget-bound only)")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--height", type=int, default=4,
+                    help="target committed height per iteration")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="starting seed (default TM_TPU_CHAOS_SEED or 0)")
+    args = ap.parse_args()
+
+    seed = args.seed if args.seed is not None else default_seed()
+    start = time.monotonic()
+    results = []
+    it = 0
+    while True:
+        if args.iters and it >= args.iters:
+            break
+        remaining = args.budget - (time.monotonic() - start)
+        if remaining <= 0:
+            break
+        res = asyncio.run(
+            run_one(seed + it, args.nodes, args.height,
+                    timeout=min(120.0, max(10.0, remaining)))
+        )
+        results.append({k: v for k, v in res.items() if k != "plan"})
+        status = "ok" if res["ok"] else "DIVERGED"
+        print(f"# iter {it} seed={res['seed']}: {status}", file=sys.stderr)
+        if not res["ok"]:
+            print(
+                f"# REPLAY: TM_TPU_CHAOS_SEED={res['seed']} "
+                f"python tools/soak.py --iters 1",
+                file=sys.stderr,
+            )
+            print(json.dumps(res))
+            return 1
+        it += 1
+
+    print(json.dumps({
+        "ok": True,
+        "iterations": it,
+        "elapsed_s": round(time.monotonic() - start, 1),
+        "results": results,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
