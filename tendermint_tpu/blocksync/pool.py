@@ -15,6 +15,8 @@ from typing import Callable, Optional
 
 from ..libs.flowrate import Monitor
 from ..libs.log import Logger, nop_logger
+from ..libs.metrics import BlocksyncMetrics, default_metrics
+from ..obs import default_tracer
 from ..types.block import Block, Commit
 
 REQUEST_WINDOW = 40  # max heights in flight (reference maxPendingRequests)
@@ -66,6 +68,7 @@ class BlockPool:
         self.height = start_height  # next height to process
         self._send_request = send_request
         self._on_peer_error = on_peer_error
+        self.metrics = default_metrics(BlocksyncMetrics)
         self.logger = logger or nop_logger()
         self._peers: dict[str, _PoolPeer] = {}
         self._requesters: dict[int, _Requester] = {}
@@ -164,7 +167,13 @@ class BlockPool:
         if p is not None:
             p.pending.discard(height)
             p.timeouts += 1
+            self.metrics.request_timeouts.inc()
+            default_tracer().event(
+                "blocksync.request_timeout", height=height,
+                peer=peer_id[:12],
+            )
             if p.timeouts >= 3:
+                self.metrics.peers_banned.inc()
                 self._on_peer_error(peer_id, "blocksync request timeouts")
                 self.remove_peer(peer_id)
 
@@ -179,6 +188,17 @@ class BlockPool:
             return False  # unsolicited from a different peer
         r.block = block
         r.peer_id = peer_id
+        if r.requested_at:
+            # request -> response latency for the assigned requester
+            latency = time.monotonic() - r.requested_at
+            self.metrics.block_response_seconds.observe(latency)
+            default_tracer().event(
+                "blocksync.block_received",
+                height=h,
+                peer=peer_id[:12],
+                latency_ms=round(latency * 1e3, 2),
+                bytes=size,
+            )
         p = self._peers.get(peer_id)
         if p is not None:
             p.pending.discard(h)

@@ -108,6 +108,7 @@ class BlocksyncReactor(Reactor):
         persistent peers are configured; reference fast_sync mode gate)."""
         if self._task is None:
             self.active = True
+            self.pool.metrics.syncing.set(1)
             self._kick_warm(self.state.validators)
             self._task = asyncio.get_running_loop().create_task(
                 self._pool_routine()
@@ -368,6 +369,8 @@ class BlocksyncReactor(Reactor):
         # background, so the vote/bulk paths never pay the build inline
         self._kick_warm(self.state.validators)
         self.blocks_applied += 1
+        self.pool.metrics.blocks_applied.inc()
+        self.pool.metrics.latest_block_height.set(first.header.height)
         self.pool.pop_request()
         if (
             self.upgrade_height
@@ -415,6 +418,7 @@ class BlocksyncReactor(Reactor):
     async def _switch_over(self) -> None:
         """SwitchToConsensus / sequencer handoff (reference :461-485)."""
         self.synced.set()
+        self.pool.metrics.syncing.set(0)
         if (
             self.upgrade_height
             and self.state.last_block_height >= self.upgrade_height

@@ -181,6 +181,11 @@ class ChaosConn:
 
         if p.drop and rng.random() < p.drop:
             self.trace.add(self.link_id, msg, ch_id, "drop", size)
+            from ..obs import default_tracer
+
+            default_tracer().event(
+                "chaos.drop", link=self.link_id, ch=ch_id, bytes=size
+            )
             return
         delay = p.latency_s
         if p.jitter_s:

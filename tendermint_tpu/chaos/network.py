@@ -23,6 +23,7 @@ import asyncio
 from typing import Optional
 
 from ..libs.log import Logger, nop_logger
+from ..obs import default_tracer
 from .link import ChaosConn, FaultTrace, LinkPolicy, link_rng
 
 
@@ -126,12 +127,20 @@ class ChaosNetwork:
         communicate until `heal(name)`."""
         self._partitions[name] = [set(g) for g in groups]
         self.trace.add("net", "partition", name, sorted(map(sorted, groups)))
+        # fault injections land in the same timeline as the step spans:
+        # the flight recorder bins this into the height in progress
+        default_tracer().event(
+            "chaos.partition",
+            name=name,
+            groups="|".join(",".join(sorted(g)) for g in groups),
+        )
         await self._enforce()
 
     async def blackhole(self, node: str) -> None:
         """Isolate one node from everyone (per-peer blackhole)."""
         self._blackholes.add(node)
         self.trace.add("net", "blackhole", node)
+        default_tracer().event("chaos.blackhole", node=node)
         await self._enforce()
 
     async def heal(self, name: Optional[str] = None) -> None:
@@ -144,6 +153,7 @@ class ChaosNetwork:
             self._partitions.pop(name, None)
             self._blackholes.discard(name)
         self.trace.add("net", "heal", name or "*")
+        default_tracer().event("chaos.heal", name=name or "*")
         for h in self._nodes.values():
             if h.switch.is_running:
                 h.switch.redial_persistent()

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
 from ..libs.log import Logger, nop_logger
+from ..obs import default_tracer
 from .link import LinkPolicy
 from .network import ChaosNetwork
 
@@ -177,6 +178,9 @@ class ScenarioRunner:
                 if due:
                     self._fired.add(i)
                     self.trace.add("fire", i, step.action)
+                    default_tracer().event(
+                        f"chaos.fire.{step.action}", height=h, step=i
+                    )
                     await self._execute(step)
             if len(self._fired) == len(self.scenario.steps):
                 live = self.live_nodes()

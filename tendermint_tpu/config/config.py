@@ -239,9 +239,19 @@ class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
     namespace: str = "tendermint"
+    # span tracer + flight recorder (tendermint_tpu/obs): when on, the
+    # node records per-step consensus spans, WAL fsyncs, device verify
+    # calls and chaos faults into a fixed-size ring served by the
+    # `dump_traces` RPC. TM_TPU_TRACE=1 enables it too.
+    trace: bool = False
+    trace_ring_size: int = 8192
+    flight_heights: int = 16
 
     def validate_basic(self) -> None:
-        pass
+        if self.trace_ring_size <= 0:
+            raise ValueError("instrumentation.trace_ring_size must be > 0")
+        if self.flight_heights <= 0:
+            raise ValueError("instrumentation.flight_heights must be > 0")
 
 
 _SECTIONS = {

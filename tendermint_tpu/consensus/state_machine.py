@@ -32,6 +32,7 @@ from typing import Callable, Optional
 from ..crypto.batch_verifier import BatchVerifier, SigItem, default_verifier
 from ..l2node.l2node import BlockData, BlsData, L2Node
 from ..libs import fail
+from ..obs import default_tracer
 from ..libs.events import EventSwitch
 from ..libs.log import Logger, nop_logger
 from ..state.execution import BlockExecutor
@@ -149,6 +150,7 @@ class ConsensusState:
         on_upgrade: Optional[Callable] = None,
         evidence_pool=None,
         metrics=None,
+        tracer=None,
         logger: Optional[Logger] = None,
         now_ns: Callable[[], int] = time.time_ns,
     ):
@@ -166,9 +168,18 @@ class ConsensusState:
         self.on_upgrade = on_upgrade
         self.evpool = evidence_pool
         self.metrics = metrics  # libs.metrics.ConsensusMetrics or None
+        self.tracer = tracer or default_tracer()
         self.logger = logger or nop_logger()
         self.now_ns = now_ns
         self._last_commit_walltime = 0.0
+        # (step_name, t0, height, round) of the step in progress — the
+        # flight recorder's per-step seam: each _new_step closes the
+        # previous step's span and opens the next
+        self._cur_step: Optional[tuple[str, float, int, int]] = None
+        # (height, round, t0) of the last PREVOTE entry — matched against
+        # the polka's height/round so a round that skipped prevote (e.g.
+        # +2/3 precommits for a future round) can't observe a stale delay
+        self._prevote_started: Optional[tuple[int, int, float]] = None
 
         self.event_switch = EventSwitch()
 
@@ -374,6 +385,24 @@ class ConsensusState:
         self.ticker.schedule(TimeoutInfo(duration_s, height, round_, step))
 
     def _new_step(self) -> None:
+        # close the previous step's span (its duration is only known at
+        # the transition) and open the next; one histogram observation
+        # per recorded span, so the exported count equals the number of
+        # step transitions the trace shows
+        rs = self.rs
+        now = time.perf_counter()
+        prev = self._cur_step
+        if prev is not None:
+            name, t0, h, r = prev
+            if self.metrics is not None:
+                self.metrics.step_duration.observe(now - t0, step=name)
+            self.tracer.add_span(
+                f"cs.{name}", t0, now - t0, height=h, round=r
+            )
+        name = rs.step.name.lower()
+        self._cur_step = (name, now, rs.height, rs.round)
+        if name == "prevote":
+            self._prevote_started = (rs.height, rs.round, now)
         self.event_switch.fire_event(EVENT_NEW_ROUND_STEP, self.rs)
 
     async def _enter_new_round(self, height: int, round_: int) -> None:
@@ -385,6 +414,14 @@ class ConsensusState:
         if round_ > rs.round:
             # round catchup: increment proposer priority view
             pass
+        if round_ > 0:
+            if self.metrics is not None:
+                self.metrics.rounds.inc()
+            self.tracer.event(
+                "cs.round_advance", height=height, round=round_
+            )
+        if self.metrics is not None:
+            self.metrics.round_gauge.set(round_)
         rs.round = round_
         rs.step = Step.NEW_ROUND
         if round_ > 0:
@@ -440,7 +477,14 @@ class ConsensusState:
         if rs.valid_block is not None:
             block, parts = rs.valid_block, rs.valid_block_parts
         else:
+            t0 = time.perf_counter()
             block, parts = await self._create_proposal_block(height)
+            dur = time.perf_counter() - t0
+            if self.metrics is not None:
+                self.metrics.proposal_create_seconds.observe(dur)
+            self.tracer.add_span(
+                "cs.proposal_create", t0, dur, height=height, round=round_
+            )
             if block is None:
                 return
         bid = BlockID(block.hash(), parts.header)
@@ -578,6 +622,8 @@ class ConsensusState:
             added = rs.proposal_block_parts.add_part(msg.part)
         except ValueError:
             raise
+        if added and self.metrics is not None:
+            self.metrics.block_parts.inc()
         if added and rs.proposal_block_parts.is_complete():
             rs.proposal_block = Block.decode(
                 rs.proposal_block_parts.get_bytes()
@@ -700,6 +746,16 @@ class ConsensusState:
         bid, ok = (
             prevotes.two_thirds_majority() if prevotes else (None, False)
         )
+        ps = self._prevote_started
+        if (
+            ok
+            and self.metrics is not None
+            and ps is not None
+            and ps[:2] == (height, round_)
+        ):
+            self.metrics.quorum_prevote_delay.observe(
+                time.perf_counter() - ps[2]
+            )
         if not ok:
             # no polka: precommit nil
             await self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
@@ -817,10 +873,19 @@ class ConsensusState:
 
         block.validate_basic()
         fail.fail_point()
+        t_commit = time.perf_counter()
         # save block + seen commit
         if self.block_store.height < height:
             seen_commit = precommits.make_commit()
-            self.block_store.save_block(block, parts, seen_commit)
+            with self.tracer.span(
+                "store.save_block", height=height, round=rs.round
+            ):
+                t_save = time.perf_counter()
+                self.block_store.save_block(block, parts, seen_commit)
+                if self.metrics is not None:
+                    self.metrics.block_store_save_seconds.observe(
+                        time.perf_counter() - t_save
+                    )
         fail.fail_point()
         # WAL barrier: after this record, the height is decided
         self.wal.write_end_height(height)
@@ -857,10 +922,21 @@ class ConsensusState:
                         validator=v.validator_address.hex()[:12],
                     )
         state_copy = self.state.copy()
-        new_state = await self.executor.apply_block(
-            state_copy, bid, block, bls_datas
-        )
+        with self.tracer.span(
+            "exec.apply_block", height=height, round=rs.round
+        ):
+            new_state = await self.executor.apply_block(
+                state_copy, bid, block, bls_datas
+            )
         fail.fail_point()
+        if self.metrics is not None:
+            self.metrics.commit_seconds.observe(
+                time.perf_counter() - t_commit
+            )
+            self.metrics.total_txs.inc(len(block.data.txs))
+            # the part set already knows the encoded size — never
+            # re-encode the block on the commit path just to measure it
+            self.metrics.block_size_bytes.observe(parts.byte_size)
 
         # batch cache rollover (reference state.go:1902-1910)
         self.batch_cache.on_block_committed(block)
@@ -1116,6 +1192,8 @@ class ConsensusState:
         val = vals.get_by_index(vote.validator_index)
         if val is None or val.address != vote.validator_address:
             return False
+        if self.metrics is not None:
+            self.metrics.votes_verified.inc(path="inline")
         ok = self.verifier.verify(
             [
                 SigItem(

@@ -23,6 +23,7 @@ from typing import Iterator, Optional
 
 from ..libs import protoio as pio
 from ..libs.autofile import Group
+from ..obs import default_tracer
 
 MAX_WAL_MSG_SIZE = 1 << 20
 
@@ -101,18 +102,39 @@ def decode_records(
 
 
 class WAL:
-    """File WAL over an autofile Group (reference BaseWAL)."""
+    """File WAL over an autofile Group (reference BaseWAL).
 
-    def __init__(self, path: str, head_size_limit: int = 10 * 1024 * 1024):
+    Every fsync is timed into `metrics.wal_fsync_seconds` (a
+    ConsensusMetrics, when given — fsync is the disk-bound slice of the
+    commit path) and the tracer's timeline as a `wal.fsync` span; the
+    flight recorder bins it into the height in progress."""
+
+    def __init__(
+        self,
+        path: str,
+        head_size_limit: int = 10 * 1024 * 1024,
+        metrics=None,
+        tracer=None,
+    ):
         self._group = Group(path, head_size_limit=head_size_limit)
         self._path = path
+        self._metrics = metrics
+        self._tracer = tracer or default_tracer()
 
     def write(self, msg: WALMessage) -> None:
         self._group.write(encode_record(msg))
 
+    def _sync_timed(self) -> None:
+        t0 = time.perf_counter()
+        self._group.sync()
+        dur = time.perf_counter() - t0
+        if self._metrics is not None:
+            self._metrics.wal_fsync_seconds.observe(dur)
+        self._tracer.add_span("wal.fsync", t0, dur)
+
     def write_sync(self, msg: WALMessage) -> None:
         self.write(msg)
-        self._group.sync()
+        self._sync_timed()
 
     def write_end_height(self, height: int) -> None:
         """The end-height barrier, fsynced (reference state.go:1853)."""
@@ -121,7 +143,7 @@ class WAL:
         )
 
     def flush_and_sync(self) -> None:
-        self._group.sync()
+        self._sync_timed()
 
     def close(self) -> None:
         self._group.close()

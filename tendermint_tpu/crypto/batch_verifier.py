@@ -32,6 +32,7 @@ Responsibilities:
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -40,6 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import default_tracer
 from ..ops import ed25519_batch
 from .ed25519 import L, challenge
 
@@ -315,6 +317,11 @@ class BatchVerifier:
                 out_shardings=(rep, rep),
             )
             self._nshards = mesh.devices.size
+        # (tier, bucket) shapes whose program has already traced through
+        # XLA — the first dispatch of a shape is jit-compile + execute,
+        # later ones pure device execute; the tracer splits them so a
+        # height's latency table doesn't blame compilation on consensus
+        self._seen_shapes: set[tuple[str, int]] = set()
         # independent locks: a big-tier build (seconds of device work for a
         # bulk replay) must not stall small-tier vote-path verifies
         self._small = _TableCache(
@@ -369,6 +376,28 @@ class BatchVerifier:
             self._big.ensure(eds, abort=abort)
 
     # --- verification ------------------------------------------------------
+
+    def _dispatch(self, fn, tier: str, b: int, n: int, *args) -> np.ndarray:
+        """Run one jitted verify program and block for the result, tracing
+        the wall time as `crypto.jit_compile` on a shape's first dispatch
+        (compile + execute) and `crypto.device_execute` afterwards."""
+        key = (tier, b)
+        first = key not in self._seen_shapes
+        self._seen_shapes.add(key)
+        tracer = default_tracer()
+        if not tracer.enabled:
+            return np.asarray(fn(*args))
+        t0 = time.perf_counter()
+        out = np.asarray(fn(*args))  # blocks until device-ready
+        tracer.add_span(
+            "crypto.jit_compile" if first else "crypto.device_execute",
+            t0,
+            time.perf_counter() - t0,
+            batch=n,
+            bucket=b,
+            tier=tier,
+        )
+        return out
 
     def verify(self, items: list[SigItem]) -> np.ndarray:
         """Returns a bool accept bitmap aligned with `items`.
@@ -497,7 +526,11 @@ class BatchVerifier:
                 continue
             tables, tvalid, idx = snap
             if device_hash:
-                out = self._msgs_fn(
+                out = self._dispatch(
+                    self._msgs_fn,
+                    "big_msgs",
+                    b,
+                    n,
                     tables,
                     tvalid,
                     jnp.asarray(idx),
@@ -508,16 +541,18 @@ class BatchVerifier:
                     jnp.asarray(s_ok),
                 )
             elif big:
-                out = self._big_fn(
+                out = self._dispatch(
+                    self._big_fn, "big", b, n,
                     tables, tvalid, jnp.asarray(idx), rb, sb, kb,
                     jnp.asarray(s_ok),
                 )
             else:
-                out = self._small_fn(
+                out = self._dispatch(
+                    self._small_fn, "small", b, n,
                     tables, tvalid, jnp.asarray(idx), rb, sb, kb,
                     jnp.asarray(s_ok),
                 )
-            return np.asarray(out)[:n]
+            return out[:n]
 
         # cache full: generic path (decompress in-batch; host challenges —
         # this fallback is the validator-churn edge, not the bulk path)
@@ -532,8 +567,10 @@ class BatchVerifier:
         pub = np.zeros((b, 32), dtype=np.uint8)
         for i in well_formed:
             pub[i] = np.frombuffer(items[i].pubkey, dtype=np.uint8)
-        out = self._fn(pub, rb, sb, kb, jnp.asarray(s_ok))
-        return np.asarray(out)[:n]
+        out = self._dispatch(
+            self._fn, "generic", b, n, pub, rb, sb, kb, jnp.asarray(s_ok)
+        )
+        return out[:n]
 
     @staticmethod
     def _verify_host_other(it: SigItem) -> bool:

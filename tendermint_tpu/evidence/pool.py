@@ -15,6 +15,8 @@ import threading
 from typing import Optional
 
 from ..libs.log import Logger, nop_logger
+from ..libs.metrics import EvidenceMetrics, default_metrics
+from ..obs import default_tracer
 from ..state.state import State
 from ..types.evidence import (
     DuplicateVoteEvidence,
@@ -63,7 +65,9 @@ class EvidencePool:
         self._consensus_buffer: list[tuple[Vote, Vote]] = []
         # in-order pending cache for gossip/proposal (reference clist)
         self._pending: dict[bytes, object] = {}
+        self.metrics = default_metrics(EvidenceMetrics)
         self._load_pending()
+        self.metrics.pool_size.set(len(self._pending))
 
     # --- queries ------------------------------------------------------------
 
@@ -237,6 +241,11 @@ class EvidencePool:
         with self._lock:
             self._kv.set(_key(_PENDING, ev.height(), ev.hash()), ev.encode())
             self._pending[ev.hash()] = ev
+            self.metrics.pool_added.inc()
+            self.metrics.pool_size.set(len(self._pending))
+        default_tracer().event(
+            "evidence.added", height=ev.height(), type=type(ev).__name__
+        )
 
     def _mark_committed(self, evs: list) -> None:
         with self._lock:
@@ -244,6 +253,9 @@ class EvidencePool:
                 self._kv.set(_key(_COMMITTED, ev.height(), ev.hash()), b"\x01")
                 self._kv.delete(_key(_PENDING, ev.height(), ev.hash()))
                 self._pending.pop(ev.hash(), None)
+            if evs:
+                self.metrics.pool_committed.inc(len(evs))
+            self.metrics.pool_size.set(len(self._pending))
 
     def _is_committed(self, ev) -> bool:
         return self._kv.get(_key(_COMMITTED, ev.height(), ev.hash())) is not None
@@ -260,6 +272,7 @@ class EvidencePool:
                 ):
                     self._kv.delete(_key(_PENDING, ev.height(), ev.hash()))
                     del self._pending[h]
+            self.metrics.pool_size.set(len(self._pending))
 
     def _load_pending(self) -> None:
         for k, v in self._kv.iterate(_PENDING, _COMMITTED):

@@ -1,10 +1,17 @@
 """Prometheus-style metrics: counters/gauges/histograms + text exposition.
 
 Reference: the metricsgen-generated structs (consensus/metrics.go:23,
-p2p/metrics.go, state/metrics.go, proxy/metrics.go:16) served at
+p2p/metrics.go, blocksync/metrics.go, statesync/metrics.go,
+state/metrics.go, proxy/metrics.go:16) served at
 InstrumentationConfig.PrometheusListenAddr (node/node.go:1062-1065).
 No external client library: the registry renders the text exposition
 format (v0.0.4) itself and a tiny asyncio HTTP server exposes /metrics.
+
+Histograms support labels (one bucket series per label-value tuple) so
+`consensus_step_duration_seconds{step=...}` is ONE histogram object, not
+one per step. Registering the same name under a different metric kind
+raises TypeError — a silent kind collision returns an object whose API
+doesn't match what the second caller asked for.
 """
 
 from __future__ import annotations
@@ -17,11 +24,33 @@ from typing import Optional
 from .service import Service
 
 
+def _escape_label(v) -> str:
+    """Label-value escaping per the text exposition format v0.0.4:
+    backslash, double-quote, and newline must be escaped."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_labels(names, values, extra: str = "") -> str:
+    pairs = ",".join(
+        f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)
+    )
+    if extra:
+        pairs = f"{pairs},{extra}" if pairs else extra
+    if not pairs:
+        return ""
+    return "{%s}" % pairs
+
+
 class Counter:
     def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
         self.name = name
         self.help = help_
-        self.label_names = labels
+        self.label_names = tuple(labels)
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
 
@@ -64,29 +93,72 @@ class Gauge(Counter):
         return out
 
 
+class _Series:
+    __slots__ = ("counts", "sum", "total")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets
+        self.sum = 0.0
+        self.total = 0
+
+
 class Histogram:
+    """Histogram, optionally labeled: one cumulative-bucket series per
+    label-value tuple (`consensus_step_duration_seconds{step="propose"}`
+    and {step="prevote"} share this object)."""
+
     DEFAULT_BUCKETS = (
         0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, float("inf")
     )
 
-    def __init__(self, name: str, help_: str, buckets=None):
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        buckets=None,
+        labels: tuple[str, ...] = (),
+    ):
         self.name = name
         self.help = help_
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
-        self._counts = [0] * len(self.buckets)
-        self._sum = 0.0
-        self._total = 0
+        self.label_names = tuple(labels)
+        self._series: dict[tuple, _Series] = {}
         self._lock = threading.Lock()
+        if not self.label_names:
+            # unlabeled histograms expose zeroed buckets before the first
+            # observation (back-compat with the original single-series
+            # render)
+            self._series[()] = _Series(len(self.buckets))
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(labels.get(k, "") for k in self.label_names)
         with self._lock:
-            self._sum += value
-            self._total += 1
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _Series(len(self.buckets))
+            s.sum += value
+            s.total += 1
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    s.counts[i] += 1
 
-    def time(self):
+    def count(self, **labels) -> int:
+        """Observation count for one series ("" defaults per label)."""
+        key = tuple(labels.get(k, "") for k in self.label_names)
+        s = self._series.get(key)
+        return s.total if s is not None else 0
+
+    def total_count(self) -> int:
+        """Observation count across ALL label series."""
+        with self._lock:
+            return sum(s.total for s in self._series.values())
+
+    def sum_value(self, **labels) -> float:
+        key = tuple(labels.get(k, "") for k in self.label_names)
+        s = self._series.get(key)
+        return s.sum if s is not None else 0.0
+
+    def time(self, **labels):
         """Context manager observing elapsed seconds."""
         h = self
 
@@ -96,7 +168,7 @@ class Histogram:
                 return self
 
             def __exit__(self, *a):
-                h.observe(time.perf_counter() - self.t0)
+                h.observe(time.perf_counter() - self.t0, **labels)
 
         return _T()
 
@@ -105,19 +177,20 @@ class Histogram:
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} histogram",
         ]
-        for b, c in zip(self.buckets, self._counts):
-            le = "+Inf" if b == float("inf") else repr(b)
-            out.append(f'{self.name}_bucket{{le="{le}"}} {c}')
-        out.append(f"{self.name}_sum {self._sum}")
-        out.append(f"{self.name}_count {self._total}")
+        with self._lock:
+            for key in sorted(self._series):
+                s = self._series[key]
+                for b, c in zip(self.buckets, s.counts):
+                    le = "+Inf" if b == float("inf") else repr(b)
+                    le_pair = 'le="%s"' % le
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{_fmt_labels(self.label_names, key, le_pair)} {c}"
+                    )
+                lbl = _fmt_labels(self.label_names, key)
+                out.append(f"{self.name}_sum{lbl} {s.sum}")
+                out.append(f"{self.name}_count{lbl} {s.total}")
         return out
-
-
-def _fmt_labels(names, values) -> str:
-    if not names:
-        return ""
-    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
-    return "{%s}" % pairs
 
 
 class Registry:
@@ -127,24 +200,38 @@ class Registry:
         self._lock = threading.Lock()
 
     def counter(self, name, help_="", labels=()) -> Counter:
-        return self._get(name, lambda n: Counter(n, help_, labels))
+        return self._get(name, Counter, lambda n: Counter(n, help_, labels))
 
     def gauge(self, name, help_="", labels=()) -> Gauge:
-        return self._get(name, lambda n: Gauge(n, help_, labels))
+        return self._get(name, Gauge, lambda n: Gauge(n, help_, labels))
 
-    def histogram(self, name, help_="", buckets=None) -> Histogram:
-        return self._get(name, lambda n: Histogram(n, help_, buckets))
+    def histogram(self, name, help_="", buckets=None, labels=()) -> Histogram:
+        return self._get(
+            name, Histogram, lambda n: Histogram(n, help_, buckets, labels)
+        )
 
-    def _get(self, name, factory):
+    def _get(self, name, kind, factory):
         full = f"{self.namespace}_{name}"
         with self._lock:
-            if full not in self._metrics:
-                self._metrics[full] = factory(full)
-            return self._metrics[full]
+            m = self._metrics.get(full)
+            if m is None:
+                m = self._metrics[full] = factory(full)
+            elif type(m) is not kind:
+                # exact-type check: Gauge subclasses Counter, so an
+                # isinstance test would silently hand a Gauge to a
+                # counter("x") call (and the original dict.get handed
+                # ANY prior registrant to ANY later kind)
+                raise TypeError(
+                    f"metric {full!r} already registered as "
+                    f"{type(m).__name__}, requested {kind.__name__}"
+                )
+            return m
 
     def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
         lines = []
-        for m in self._metrics.values():
+        for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
 
@@ -169,6 +256,9 @@ class ConsensusMetrics:
         self.rounds = reg.counter(
             "consensus_rounds", "Rounds entered beyond round 0"
         )
+        self.round_gauge = reg.gauge(
+            "consensus_round", "Current consensus round"
+        )
         self.validators = reg.gauge(
             "consensus_validators", "Validator set size"
         )
@@ -185,6 +275,44 @@ class ConsensusMetrics:
             "Signatures per device verify batch",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 512, 2048, float("inf")),
         )
+        # --- the per-step latency surface (reference metricsgen
+        # StepDurationSeconds) -------------------------------------------
+        self.step_duration = reg.histogram(
+            "consensus_step_duration_seconds",
+            "Time at each consensus step before transitioning",
+            labels=("step",),
+        )
+        self.proposal_create_seconds = reg.histogram(
+            "consensus_proposal_create_seconds",
+            "Time building + sealing a proposal block",
+        )
+        self.commit_seconds = reg.histogram(
+            "consensus_commit_seconds",
+            "finalizeCommit wall time (save + WAL barrier + apply)",
+        )
+        self.block_store_save_seconds = reg.histogram(
+            "consensus_block_store_save_seconds",
+            "Block-store save_block wall time at commit",
+        )
+        self.wal_fsync_seconds = reg.histogram(
+            "consensus_wal_fsync_seconds",
+            "WAL fsync wall time",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                     float("inf")),
+        )
+        self.block_size_bytes = reg.histogram(
+            "consensus_block_size_bytes",
+            "Committed block size",
+            buckets=(1024, 4096, 16384, 65536, 262144, 1048576, 4194304,
+                     float("inf")),
+        )
+        self.block_parts = reg.counter(
+            "consensus_block_parts", "Block parts received"
+        )
+        self.quorum_prevote_delay = reg.histogram(
+            "consensus_quorum_prevote_delay_seconds",
+            "Prevote-step start to +2/3 prevotes observed",
+        )
 
 
 class P2PMetrics:
@@ -197,10 +325,123 @@ class P2PMetrics:
         self.message_send_bytes = reg.counter(
             "p2p_message_send_bytes_total", "Bytes sent", ("chID",)
         )
+        self.send_queue_depth = reg.gauge(
+            "p2p_send_queue_depth", "Per-channel send-queue depth", ("chID",)
+        )
+        self.send_queue_full = reg.counter(
+            "p2p_send_queue_full_total",
+            "Messages rejected by a full send queue",
+            ("chID",),
+        )
+        self.send_stall_seconds = reg.counter(
+            "p2p_send_stall_seconds_total",
+            "Time the send routine spent rate-throttled",
+        )
+
+
+class BlocksyncMetrics:
+    """blocksync/metrics.go: Syncing, LatestBlockHeight + the pool's
+    request/response health."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.syncing = reg.gauge(
+            "blocksync_syncing", "1 while block-syncing, else 0"
+        )
+        self.latest_block_height = reg.gauge(
+            "blocksync_latest_block_height", "Height of the latest applied block"
+        )
+        self.blocks_applied = reg.counter(
+            "blocksync_blocks_applied_total", "Blocks applied by blocksync"
+        )
+        self.block_response_seconds = reg.histogram(
+            "blocksync_block_response_seconds",
+            "Block request to response latency",
+        )
+        self.request_timeouts = reg.counter(
+            "blocksync_request_timeouts_total", "Block requests that timed out"
+        )
+        self.peers_banned = reg.counter(
+            "blocksync_peers_banned_total", "Peers banned by the pool"
+        )
+
+
+class StateSyncMetrics:
+    """statesync/metrics.go: Syncing, SnapshotHeight, chunk health."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.syncing = reg.gauge(
+            "statesync_syncing", "1 while state-syncing, else 0"
+        )
+        self.snapshot_height = reg.gauge(
+            "statesync_snapshot_height", "Height of the snapshot being restored"
+        )
+        self.chunks_fetched = reg.counter(
+            "statesync_chunks_fetched_total", "Snapshot chunks received"
+        )
+        self.chunk_retries = reg.counter(
+            "statesync_chunk_retries_total", "Snapshot chunk refetches"
+        )
+        self.chunk_response_seconds = reg.histogram(
+            "statesync_chunk_response_seconds",
+            "Chunk request to response latency",
+        )
+
+
+class RPCMetrics:
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.requests = reg.counter(
+            "rpc_requests_total", "JSON-RPC requests served", ("method",)
+        )
+        self.request_errors = reg.counter(
+            "rpc_request_errors_total", "JSON-RPC error responses", ("method",)
+        )
+        self.request_duration = reg.histogram(
+            "rpc_request_duration_seconds",
+            "JSON-RPC handler wall time",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                     float("inf")),
+            labels=("method",),
+        )
+
+
+class EvidenceMetrics:
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.pool_size = reg.gauge(
+            "evidence_pool_size", "Pending evidence in the pool"
+        )
+        self.pool_added = reg.counter(
+            "evidence_pool_added_total", "Evidence verified into the pool"
+        )
+        self.pool_committed = reg.counter(
+            "evidence_pool_committed_total", "Evidence marked committed"
+        )
+
+
+# one shared instance per metric-set class on the default registry, for
+# seams (p2p conn, blocksync pool, chunk queue, evidence pool) that are
+# constructed far from node assembly and aren't handed a registry
+_default_sets: dict[type, object] = {}
+_default_sets_lock = threading.Lock()
+
+
+def default_metrics(cls):
+    inst = _default_sets.get(cls)
+    if inst is None:
+        with _default_sets_lock:
+            inst = _default_sets.get(cls)
+            if inst is None:
+                inst = _default_sets[cls] = cls(default_registry())
+    return inst
 
 
 class MetricsServer(Service):
-    """Serves GET /metrics in the text exposition format."""
+    """Serves GET/HEAD /metrics in the text exposition format; anything
+    else is 404 (the original served the registry for EVERY path and
+    verb)."""
 
     def __init__(self, registry: Registry, host: str, port: int):
         super().__init__("metrics")
@@ -222,18 +463,38 @@ class MetricsServer(Service):
 
     async def _handle(self, reader, writer):
         try:
-            await reader.readline()  # request line; drain headers
+            req_line = await reader.readline()
             while True:
                 line = await reader.readline()
                 if line in (b"\r\n", b"\n", b""):
                     break
-            body = self.registry.render().encode()
-            writer.write(
-                b"HTTP/1.1 200 OK\r\n"
-                b"Content-Type: text/plain; version=0.0.4\r\n"
-                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
-                b"Connection: close\r\n\r\n" + body
-            )
+            try:
+                method, target, _ = req_line.decode().strip().split(" ", 2)
+            except (ValueError, UnicodeDecodeError):
+                return
+            path = target.split("?", 1)[0]
+            if path != "/metrics":
+                self._respond(writer, 404, b"not found\n")
+            elif method == "GET":
+                self._respond(writer, 200, self.registry.render().encode())
+            elif method == "HEAD":
+                self._respond(
+                    writer, 200, self.registry.render().encode(), head=True
+                )
+            else:
+                self._respond(writer, 405, b"method not allowed\n")
             await writer.drain()
         finally:
             writer.close()
+
+    @staticmethod
+    def _respond(writer, status: int, body: bytes, head: bool = False) -> None:
+        reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed"}[
+            status
+        ]
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            "Content-Type: text/plain; version=0.0.4\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n".encode() + (b"" if head else body)
+        )

@@ -340,9 +340,25 @@ class Node(Service):
 
         # --- consensus (node.go:460-501) ---
         from ..libs.metrics import ConsensusMetrics, default_registry
+        from .. import obs
 
         self.metrics_registry = default_registry()
-        wal = WAL(config.wal_file)
+        # flight recorder: installed as the process default so every seam
+        # without an explicit handle (batch verifier, p2p conns, chaos)
+        # lands in the SAME timeline as the consensus step spans
+        self.tracer = obs.set_default_tracer(
+            obs.Tracer(
+                enabled=(
+                    config.instrumentation.trace
+                    or os.environ.get("TM_TPU_TRACE") == "1"
+                ),
+                ring_size=config.instrumentation.trace_ring_size,
+            )
+        )
+        consensus_metrics = ConsensusMetrics(self.metrics_registry)
+        wal = WAL(
+            config.wal_file, metrics=consensus_metrics, tracer=self.tracer
+        )
         self.consensus = ConsensusState(
             config.consensus.to_state_machine_config(),
             state,
@@ -356,7 +372,8 @@ class Node(Service):
             upgrade_height=config.consensus.switch_height,
             on_upgrade=self._switch_to_sequencer_mode,
             evidence_pool=self.evidence_pool,
-            metrics=ConsensusMetrics(self.metrics_registry),
+            metrics=consensus_metrics,
+            tracer=self.tracer,
             logger=self.logger,
         )
         self.consensus_reactor = ConsensusReactor(

@@ -1,0 +1,26 @@
+"""Observability: span tracing, flight recorder, latency attribution.
+
+The layer every perf PR is judged against — see tracer.py for the design
+notes. Stdlib only."""
+
+from .report import ascii_timeline, attribution, attribution_table
+from .tracer import (
+    DEFAULT_RING_SIZE,
+    SpanRecord,
+    Tracer,
+    default_tracer,
+    flight_snapshot,
+    set_default_tracer,
+)
+
+__all__ = [
+    "DEFAULT_RING_SIZE",
+    "SpanRecord",
+    "Tracer",
+    "ascii_timeline",
+    "attribution",
+    "attribution_table",
+    "default_tracer",
+    "flight_snapshot",
+    "set_default_tracer",
+]

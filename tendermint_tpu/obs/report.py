@@ -1,0 +1,117 @@
+"""Latency attribution + ASCII timelines over tracer dumps.
+
+`attribution` answers "where does a height's time go" in aggregate
+(p50/p95 per span name); `ascii_timeline` renders one run's flight
+recorder as a per-height step table — the artifact soak.py ships with a
+diverging seed and tools/trace_report.py renders from a dump file.
+
+Operates on plain record dicts (`SpanRecord.to_json()` shape) so it can
+consume a `dump_traces` RPC response or a JSON file equally.
+"""
+
+from __future__ import annotations
+
+from .tracer import SpanRecord, flight_snapshot
+
+# consensus step spans in canonical order (state_machine Step enum)
+STEP_ORDER = (
+    "cs.new_height",
+    "cs.new_round",
+    "cs.propose",
+    "cs.prevote",
+    "cs.prevote_wait",
+    "cs.precommit",
+    "cs.precommit_wait",
+    "cs.commit",
+)
+
+
+def _pct(xs: list[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def attribution(records: list[dict]) -> dict:
+    """Per-span-name p50/p95/max duration (ms) + count over span records.
+    The bench/soak artifacts attach this so a throughput scalar comes
+    with its breakdown."""
+    durs: dict[str, list[float]] = {}
+    heights = set()
+    for r in records:
+        if r.get("kind") != "span":
+            continue
+        durs.setdefault(r["name"], []).append(r.get("dur", 0.0) * 1e3)
+        if r.get("height"):
+            heights.add(r["height"])
+
+    def key(name: str):
+        return (
+            STEP_ORDER.index(name) if name in STEP_ORDER else len(STEP_ORDER),
+            name,
+        )
+
+    return {
+        "heights": len(heights),
+        "steps": {
+            name: {
+                "count": len(ds),
+                "p50_ms": round(_pct(ds, 0.5), 3),
+                "p95_ms": round(_pct(ds, 0.95), 3),
+                "max_ms": round(max(ds), 3),
+            }
+            for name, ds in sorted(durs.items(), key=lambda kv: key(kv[0]))
+        },
+    }
+
+
+def ascii_timeline(records: list[dict], n_heights: int = 16) -> str:
+    """Per-height step-timeline table. Spans show offset + duration from
+    the height's first record; events render as `!` annotations at their
+    offset — a chaos partition lands visibly inside the height it hit."""
+    recs = [SpanRecord.from_json(r) for r in records]
+    flight = flight_snapshot(recs, n_heights)
+    if not flight:
+        return "(no trace records)"
+    lines = []
+    for h in sorted(flight):
+        rows = flight[h]
+        t_base = min(r["t0"] for r in rows)
+        t_end = max(r["t0"] + r.get("dur", 0.0) for r in rows)
+        lines.append(
+            f"height {h}  ({(t_end - t_base) * 1e3:.1f} ms, "
+            f"{len(rows)} records)"
+        )
+        lines.append(f"  {'span':<28} {'t+ms':>9} {'dur_ms':>9}")
+        for r in rows:
+            off = (r["t0"] - t_base) * 1e3
+            if r["kind"] == "span":
+                lines.append(
+                    f"  {r['name']:<28} {off:>9.2f} "
+                    f"{r.get('dur', 0.0) * 1e3:>9.2f}"
+                )
+            else:
+                extra = ""
+                if r.get("fields"):
+                    extra = " " + ",".join(
+                        f"{k}={v}" for k, v in sorted(r["fields"].items())
+                    )
+                lines.append(f"  ! {r['name']:<26} {off:>9.2f}{extra}")
+    return "\n".join(lines)
+
+
+def attribution_table(records: list[dict]) -> str:
+    """The attribution dict rendered as an aligned text table."""
+    att = attribution(records)
+    lines = [
+        f"latency attribution over {att['heights']} heights",
+        f"  {'span':<28} {'count':>6} {'p50_ms':>9} {'p95_ms':>9} "
+        f"{'max_ms':>9}",
+    ]
+    for name, s in att["steps"].items():
+        lines.append(
+            f"  {name:<28} {s['count']:>6} {s['p50_ms']:>9.2f} "
+            f"{s['p95_ms']:>9.2f} {s['max_ms']:>9.2f}"
+        )
+    return "\n".join(lines)

@@ -22,6 +22,8 @@ from typing import Awaitable, Callable, Optional
 
 from ..libs.flowrate import Monitor
 from ..libs.log import Logger, nop_logger
+from ..libs.metrics import P2PMetrics, default_metrics
+from ..obs import default_tracer
 
 MAX_PACKET_PAYLOAD = 1000
 _PING = 0xFE
@@ -78,6 +80,7 @@ class MConnection:
         ping_interval: float = 10.0,
         send_rate: int = DEFAULT_SEND_RATE,
         recv_rate: int = DEFAULT_RECV_RATE,
+        metrics: Optional[P2PMetrics] = None,
         logger: Optional[Logger] = None,
     ):
         self._conn = conn
@@ -87,6 +90,9 @@ class MConnection:
         self._ping_interval = ping_interval
         self._send_rate = send_rate
         self._recv_rate = recv_rate
+        # per-channel queue depth / full-drop / stall accounting; shared
+        # process-wide set unless the assembler passes its own
+        self.metrics = metrics or default_metrics(P2PMetrics)
         # public: peer-quality metrics read these (reference Status())
         self.send_monitor = Monitor()
         self.recv_monitor = Monitor()
@@ -132,7 +138,19 @@ class MConnection:
         try:
             ch.send_queue.put_nowait(msg)
         except asyncio.QueueFull:
+            self.metrics.send_queue_full.inc(chID=f"{channel_id:#04x}")
+            default_tracer().event(
+                "p2p.send_queue_full",
+                ch=f"{channel_id:#04x}",
+                depth=ch.send_queue.qsize(),
+            )
             return False
+        self.metrics.send_queue_depth.set(
+            ch.send_queue.qsize(), chID=f"{channel_id:#04x}"
+        )
+        self.metrics.message_send_bytes.inc(
+            len(msg), chID=f"{channel_id:#04x}"
+        )
         self._send_signal.set()
         return True
 
@@ -169,8 +187,24 @@ class MConnection:
         if best is None:
             return False
         chunk, eof = best.next_packet()
+        if eof:
+            # keep the depth gauge honest on drain, not just on enqueue
+            self.metrics.send_queue_depth.set(
+                best.send_queue.qsize(), chID=f"{best.desc.id:#04x}"
+            )
         pkt = bytes([best.desc.id, 1 if eof else 0]) + chunk
+        t0 = time.perf_counter()
         await self._throttle(self.send_monitor, len(pkt), self._send_rate)
+        stalled = time.perf_counter() - t0
+        if stalled >= _THROTTLE_TICK:
+            # rate-cap back-pressure: the slice of send time the link
+            # budget (not the peer) is responsible for
+            self.metrics.send_stall_seconds.inc(stalled)
+            default_tracer().event(
+                "p2p.send_stall",
+                ch=f"{best.desc.id:#04x}",
+                stall_ms=round(stalled * 1e3, 2),
+            )
         await self._conn.write(pkt)
         self.send_monitor.update(len(pkt))
         # decay counters so priorities stay relative
@@ -203,6 +237,9 @@ class MConnection:
                 if eof:
                     msg = bytes(ch.recv_buf)
                     ch.recv_buf = bytearray()
+                    self.metrics.message_receive_bytes.inc(
+                        len(msg), chID=f"{ch_id:#04x}"
+                    )
                     await self._on_receive(ch_id, msg)
         except asyncio.CancelledError:
             raise

@@ -287,6 +287,7 @@ class Switch(Service):
         peer = Peer(info, sconn, mconn, outbound, addr)
         peer_holder.append(peer)
         self.peers[peer.id] = peer
+        mconn.metrics.peers.set(len(self.peers))
         mconn.start()
         for r in self.reactors.values():
             await r.add_peer(peer)
@@ -319,6 +320,7 @@ class Switch(Service):
     async def _stop_and_remove(self, peer: Peer, reason: str) -> None:
         if self.peers.get(peer.id) is peer:
             del self.peers[peer.id]
+            peer.mconn.metrics.peers.set(len(self.peers))
         await peer.stop()
         for r in self.reactors.values():
             await r.remove_peer(peer, reason)

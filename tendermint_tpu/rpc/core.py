@@ -80,6 +80,7 @@ class RPCCore:
             "validators": self.validators,
             "consensus_state": self.consensus_state,
             "dump_consensus_state": self.dump_consensus_state,
+            "dump_traces": self.dump_traces,
             "consensus_params": self.consensus_params,
             "tx": self.tx,
             "tx_search": self.tx_search,
@@ -371,6 +372,41 @@ class RPCCore:
             {"node_address": p.id} for p in self.node.switch.peers.values()
         ]
         return out
+
+    def dump_traces(self, format=None, heights=None, **_kw) -> dict:
+        """Flight-recorder dump (tendermint_tpu/obs). Formats:
+        - default: the raw span ring + the last-N-heights flight view;
+        - format=chrome: a Chrome trace_event JSON object — save
+          `result.trace` to a file and load it in Perfetto."""
+        from .. import obs
+
+        tracer = getattr(self.node, "tracer", None) or obs.default_tracer()
+        records = tracer.records()
+        if format == "chrome":
+            return {
+                "enabled": tracer.enabled,
+                "trace": tracer.to_chrome_trace(records),
+            }
+        try:
+            n = int(heights) if heights else 16
+        except (TypeError, ValueError):
+            from .server import RPCError
+
+            raise RPCError(-32602, "invalid heights: not an integer") from None
+        if n <= 0:
+            n = 16  # flight_snapshot slices [-n:]; non-positive would
+            # return everything instead of nothing
+        recs = [r.to_json() for r in records]
+        return {
+            "enabled": tracer.enabled,
+            "epoch_wall_ns": tracer.epoch_wall_ns,
+            "records": recs,
+            "flight": {
+                str(h): rows
+                for h, rows in obs.flight_snapshot(records, n).items()
+            },
+            "attribution": obs.attribution(recs),
+        }
 
     def consensus_params(self, height=None, **_kw) -> dict:
         state = self.node.consensus.state

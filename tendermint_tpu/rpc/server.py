@@ -15,9 +15,11 @@ import base64
 import hashlib
 import json
 import struct
+import time
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..libs.metrics import RPCMetrics, default_metrics
 from ..libs.service import Service
 from .core import RPCCore
 
@@ -156,19 +158,32 @@ class RPCServer(Service):
         if not isinstance(params, dict):
             return _err(rid, -32602, "invalid params: not an object")
         fn = self.core.routes().get(name)
+        metrics = default_metrics(RPCMetrics)
         if fn is None:
+            # unknown methods share one label so a hostile client can't
+            # explode the metric's cardinality
+            metrics.request_errors.inc(method="_unknown")
             return _err(rid, -32601, f"method {name!r} not found")
+        metrics.requests.inc(method=name)
+        t0 = time.perf_counter()
         try:
             res = fn(**params)
             if asyncio.iscoroutine(res):
                 res = await res
             return {"jsonrpc": "2.0", "id": rid, "result": res}
         except RPCError as e:
+            metrics.request_errors.inc(method=name)
             return _err(rid, e.code, e.message)
         except TypeError as e:
+            metrics.request_errors.inc(method=name)
             return _err(rid, -32602, f"invalid params: {e}")
         except Exception as e:
+            metrics.request_errors.inc(method=name)
             return _err(rid, -32603, f"internal error: {e}")
+        finally:
+            metrics.request_duration.observe(
+                time.perf_counter() - t0, method=name
+            )
 
     # --- websocket (reference ws_handler :29) --------------------------------
 

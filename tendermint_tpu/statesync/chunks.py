@@ -13,6 +13,9 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from ..libs.metrics import StateSyncMetrics, default_metrics
+from ..obs import default_tracer
+
 # per-chunk refetch backoff: the seed refetched immediately from the same
 # pool, hammering a bad peer in a tight loop; failed chunks now wait
 # BASE·2ⁿ (capped) before they are allocatable again, mirroring the
@@ -39,6 +42,8 @@ class ChunkQueue:
         self._retries: dict[int, int] = {}  # index -> failed attempts
         self._retry_at: dict[int, float] = {}  # index -> earliest refetch
         self._last_sender: dict[int, str] = {}  # index -> last failing peer
+        self._requested_at: dict[int, float] = {}  # index -> request time
+        self.metrics = default_metrics(StateSyncMetrics)
         self._event = asyncio.Event()
         self._closed = False
 
@@ -60,6 +65,7 @@ class ChunkQueue:
         timeout-driven retry can rotate away from it."""
         if index in self._allocated:
             self._allocated[index] = peer_id
+            self._requested_at[index] = self._now()
 
     def add(self, chunk: Chunk) -> bool:
         """Returns False for duplicates/out-of-range."""
@@ -71,6 +77,17 @@ class ChunkQueue:
             return False
         self._chunks[chunk.index] = chunk
         self._allocated.pop(chunk.index, None)
+        self.metrics.chunks_fetched.inc()
+        req_t = self._requested_at.pop(chunk.index, 0.0)
+        if req_t:
+            latency = self._now() - req_t
+            self.metrics.chunk_response_seconds.observe(latency)
+            default_tracer().event(
+                "statesync.chunk_received",
+                index=chunk.index,
+                peer=chunk.sender[:12],
+                latency_ms=round(latency * 1e3, 2),
+            )
         self._event.set()
         return True
 
@@ -89,6 +106,8 @@ class ChunkQueue:
         )
         self._chunks.pop(index, None)
         self._allocated.pop(index, None)
+        self._requested_at.pop(index, None)
+        self.metrics.chunk_retries.inc()
         n = self._retries.get(index, 0)
         self._retries[index] = n + 1
         self._retry_at[index] = self._now() + min(

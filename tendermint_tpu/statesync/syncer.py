@@ -179,6 +179,8 @@ class Syncer:
     async def sync(self, d: _DiscoveredSnapshot, chunks: ChunkQueue):
         self._current = d
         self._chunks = chunks
+        chunks.metrics.syncing.set(1)
+        chunks.metrics.snapshot_height.set(d.snapshot.height)
         try:
             # trusted app hash from the light-client state provider
             d.trusted_app_hash = await self._provider.app_hash(
@@ -209,6 +211,7 @@ class Syncer:
             )
             return state, commit
         finally:
+            chunks.metrics.syncing.set(0)
             self._current = None
             self._chunks = None
 

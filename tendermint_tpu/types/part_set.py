@@ -120,6 +120,10 @@ class PartSet:
         return self._bit_array.copy()
 
     @property
+    def byte_size(self) -> int:
+        return self._byte_size
+
+    @property
     def count(self) -> int:
         return self._count
 

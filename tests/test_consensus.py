@@ -37,6 +37,8 @@ def make_node(
     upgrade_height=0,
     on_upgrade=None,
     bls_signer=None,
+    metrics=None,
+    tracer=None,
 ):
     l2 = l2 or MockL2Node()
     app = KVStoreApplication()
@@ -55,6 +57,8 @@ def make_node(
         upgrade_height=upgrade_height,
         on_upgrade=on_upgrade,
         bls_signer=bls_signer,
+        metrics=metrics,
+        tracer=tracer,
     )
     return cs, app, l2, block_store, state_store
 

@@ -1,0 +1,199 @@
+"""Metrics exposition golden tests: Prometheus text format v0.0.4
+validity (HELP/TYPE ordering, cumulative `le` monotonicity, label-value
+escaping), the Registry kind-collision guard, labeled histograms, and
+the /metrics server's path/verb handling."""
+
+import asyncio
+import re
+
+import pytest
+
+from tendermint_tpu.libs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsServer,
+    Registry,
+)
+
+pytestmark = pytest.mark.obs
+
+
+# --- registry kind collisions ---------------------------------------------
+
+
+def test_registry_kind_collision_raises():
+    reg = Registry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+    with pytest.raises(TypeError):
+        reg.histogram("x")
+
+
+def test_registry_gauge_then_counter_raises():
+    # Gauge subclasses Counter: an isinstance check would wrongly allow
+    # counter("y") to return the Gauge
+    reg = Registry()
+    reg.gauge("y")
+    with pytest.raises(TypeError):
+        reg.counter("y")
+
+
+def test_registry_same_kind_returns_same_object():
+    reg = Registry()
+    c1 = reg.counter("z", "help")
+    c2 = reg.counter("z")
+    assert c1 is c2
+    h1 = reg.histogram("hh", labels=("step",))
+    assert reg.histogram("hh") is h1
+
+
+# --- exposition format -----------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+infINF]+$"
+)
+
+
+def _build_golden_registry() -> Registry:
+    reg = Registry(namespace="tm")
+    c = reg.counter("requests_total", "Requests", labels=("method",))
+    c.inc(3, method="status")
+    c.inc(method='we"ird\\path\nx')  # exercises label escaping
+    g = reg.gauge("height", "Height")
+    g.set(42)
+    h = reg.histogram(
+        "step_seconds",
+        "Step durations",
+        buckets=(0.1, 1.0, float("inf")),
+        labels=("step",),
+    )
+    h.observe(0.05, step="propose")
+    h.observe(0.5, step="propose")
+    h.observe(2.0, step="prevote")
+    return reg
+
+
+def test_exposition_help_type_ordering_and_samples():
+    body = _build_golden_registry().render()
+    lines = body.strip().splitlines()
+    seen_types: dict[str, str] = {}
+    current = None
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            current = ln.split()[2]
+            # HELP must precede TYPE for each metric family
+            assert current not in seen_types
+        elif ln.startswith("# TYPE "):
+            name, kind = ln.split()[2:4]
+            assert name == current, "TYPE must follow its HELP line"
+            seen_types[name] = kind
+        else:
+            # sample lines parse and belong to an announced family
+            assert _SAMPLE_RE.match(ln), f"unparseable sample: {ln!r}"
+            base = ln.split("{")[0].split(" ")[0]
+            family = re.sub(r"_(bucket|sum|count)$", "", base)
+            assert family in seen_types or base in seen_types
+    assert seen_types == {
+        "tm_requests_total": "counter",
+        "tm_height": "gauge",
+        "tm_step_seconds": "histogram",
+    }
+
+
+def test_exposition_label_escaping():
+    body = _build_golden_registry().render()
+    assert 'method="we\\"ird\\\\path\\nx"' in body
+    # no raw newline may survive inside a label value
+    for ln in body.splitlines():
+        assert not ln.endswith("\\")
+
+
+def test_histogram_le_cumulative_monotonic():
+    body = _build_golden_registry().render()
+    # collect bucket counts per label-series, in render order
+    series: dict[str, list[float]] = {}
+    for ln in body.splitlines():
+        m = re.match(r"tm_step_seconds_bucket\{step=\"(\w+)\",le=\"([^\"]+)\"\} (\S+)", ln)
+        if m:
+            series.setdefault(m.group(1), []).append(float(m.group(3)))
+    assert set(series) == {"propose", "prevote"}
+    for name, counts in series.items():
+        assert counts == sorted(counts), f"{name} buckets not cumulative"
+    # +Inf bucket equals _count
+    assert series["propose"][-1] == 2
+    assert series["prevote"][-1] == 1
+    assert "tm_step_seconds_count{step=\"propose\"} 2" in body
+    assert "tm_step_seconds_sum{step=\"propose\"} 0.55" in body
+
+
+def test_labeled_histogram_counts():
+    h = Histogram("h", "", buckets=(1, float("inf")), labels=("step",))
+    h.observe(0.5, step="a")
+    h.observe(0.5, step="a")
+    h.observe(3.0, step="b")
+    assert h.count(step="a") == 2
+    assert h.count(step="b") == 1
+    assert h.total_count() == 3
+    with h.time(step="a"):
+        pass
+    assert h.count(step="a") == 3
+
+
+def test_unlabeled_histogram_renders_zero_buckets():
+    h = Histogram("h", "help", buckets=(1, float("inf")))
+    out = h.render()
+    assert 'h_bucket{le="1"} 0' in out
+    assert "h_count 0" in out
+
+
+# --- /metrics server -------------------------------------------------------
+
+
+async def _http(port: int, request: bytes) -> bytes:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(request)
+    await writer.drain()
+    data = await reader.read(1 << 20)
+    writer.close()
+    return data
+
+
+def test_metrics_server_paths_and_verbs():
+    reg = _build_golden_registry()
+
+    async def run():
+        srv = MetricsServer(reg, "127.0.0.1", 0)
+        await srv.start()
+        try:
+            ok = await _http(
+                srv.port, b"GET /metrics HTTP/1.1\r\nHost: m\r\n\r\n"
+            )
+            nf = await _http(
+                srv.port, b"GET /other HTTP/1.1\r\nHost: m\r\n\r\n"
+            )
+            head = await _http(
+                srv.port, b"HEAD /metrics HTTP/1.1\r\nHost: m\r\n\r\n"
+            )
+            post = await _http(
+                srv.port, b"POST /metrics HTTP/1.1\r\nHost: m\r\n\r\n"
+            )
+            return ok, nf, head, post
+        finally:
+            await srv.stop()
+
+    ok, nf, head, post = asyncio.run(run())
+    assert ok.startswith(b"HTTP/1.1 200") and b"tm_height 42" in ok
+    assert nf.startswith(b"HTTP/1.1 404")
+    assert b"tm_height" not in nf
+    # HEAD: headers with the real content length, no body
+    assert head.startswith(b"HTTP/1.1 200")
+    headers, _, body = head.partition(b"\r\n\r\n")
+    assert body == b""
+    clen = int(
+        [h for h in headers.split(b"\r\n") if h.lower().startswith(
+            b"content-length")][0].split(b":")[1]
+    )
+    assert clen == len(reg.render().encode())
+    assert post.startswith(b"HTTP/1.1 405")

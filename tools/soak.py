@@ -28,6 +28,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tendermint_tpu import obs
 from tendermint_tpu.chaos import ScenarioRunner, random_scenario
 from tendermint_tpu.chaos.scenario import default_seed
 
@@ -39,6 +40,12 @@ async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
         start_mesh,
         stop_mesh,
     )
+
+    # flight recorder on for every iteration: a diverging seed ships with
+    # its per-height step timeline, not just the scenario plan
+    tracer = obs.default_tracer()
+    tracer.enabled = True
+    tracer.clear()
 
     handles = build_chaos_handles(n_nodes)
     scenario = random_scenario(seed, [h.name for h in handles])
@@ -52,18 +59,26 @@ async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
             for name, seq in heights.items()
             if runner.nodes[name].alive
         )
-        return {
+        records = [r.to_json() for r in tracer.records()]
+        out = {
             "seed": seed,
             "ok": converged,
             "heights": {k: (v[-1] if v else 0) for k, v in heights.items()},
             "forks": len(hashes),
+            "latency_attribution": obs.attribution(records),
             "plan": runner.plan_jsonl().decode(),
         }
+        if not converged:
+            out["trace_report"] = obs.ascii_timeline(records)
+        return out
     except TimeoutError as e:
+        records = [r.to_json() for r in tracer.records()]
         return {
             "seed": seed,
             "ok": False,
             "error": str(e),
+            "latency_attribution": obs.attribution(records),
+            "trace_report": obs.ascii_timeline(records),
             "plan": runner.plan_jsonl().decode(),
         }
     finally:
@@ -106,6 +121,8 @@ def main() -> int:
                 f"python tools/soak.py --iters 1",
                 file=sys.stderr,
             )
+            if res.get("trace_report"):
+                print(res["trace_report"], file=sys.stderr)
             print(json.dumps(res))
             return 1
         it += 1

@@ -1,0 +1,83 @@
+"""Render a flight-recorder dump as per-height ASCII step timelines.
+
+Input: JSON from the `dump_traces` RPC route (or any file holding either
+that response shape, a bare record list, or a Chrome trace export written
+by `Tracer.to_chrome_trace`). Output: one step-timeline table per height
+plus the aggregate latency-attribution table — the artifact a failing
+soak seed ships with, so a divergence report explains where the stalled
+height's time went without re-running anything.
+
+Usage:
+    python tools/trace_report.py dump.json [--heights N]
+    curl -s localhost:26657/dump_traces | python tools/trace_report.py -
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.obs import ascii_timeline, attribution_table
+
+
+def extract_records(doc) -> list[dict]:
+    """Normalize any of the supported dump shapes to a record list."""
+    if isinstance(doc, list):
+        return doc
+    if not isinstance(doc, dict):
+        raise ValueError("unrecognized trace dump shape")
+    if "records" in doc:
+        return doc["records"]
+    if "result" in doc and isinstance(doc["result"], dict):
+        return extract_records(doc["result"])
+    if "traceEvents" in doc or (
+        "trace" in doc and isinstance(doc["trace"], dict)
+    ):
+        events = (doc.get("trace") or doc)["traceEvents"]
+        return [
+            {
+                "name": e.get("name", ""),
+                "t0": e.get("ts", 0.0) / 1e6,
+                "dur": e.get("dur", 0.0) / 1e6,
+                "height": (e.get("args") or {}).get("height", e.get("tid", 0)),
+                "round": (e.get("args") or {}).get("round", 0),
+                "kind": "span" if e.get("ph") == "X" else "event",
+                "fields": {
+                    k: v
+                    for k, v in (e.get("args") or {}).items()
+                    if k not in ("height", "round")
+                },
+            }
+            for e in events
+        ]
+    raise ValueError("unrecognized trace dump shape")
+
+
+def render(doc, n_heights: int = 16) -> str:
+    records = extract_records(doc)
+    return "\n\n".join(
+        [ascii_timeline(records, n_heights), attribution_table(records)]
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="dump file, or - for stdin")
+    ap.add_argument("--heights", type=int, default=16,
+                    help="show the last N heights (default 16)")
+    args = ap.parse_args(argv)
+    if args.path == "-":
+        doc = json.load(sys.stdin)
+    else:
+        with open(args.path) as f:
+            doc = json.load(f)
+    print(render(doc, args.heights))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
