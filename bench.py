@@ -37,6 +37,38 @@ from tendermint_tpu.libs.jax_cache import set_compile_cache_env
 set_compile_cache_env()
 
 BASELINE_SERIAL_SIGS_PER_S = 15_000.0
+
+
+def _reg_snapshot() -> dict:
+    """Shape-registry snapshot; paired with _shape_stats around each
+    metric so the JSON artifact carries per-metric
+    distinct_program_shapes / device_dispatch_count (PERF_ANALYSIS §10:
+    shape churn and dispatch counts were only visible via cProfile)."""
+    from tendermint_tpu.crypto.shape_registry import default_shape_registry
+
+    return default_shape_registry().snapshot()
+
+
+def _shape_stats(before: dict) -> dict:
+    from tendermint_tpu.crypto.shape_registry import (
+        ShapeRegistry,
+        default_shape_registry,
+    )
+
+    return ShapeRegistry.delta(
+        before, default_shape_registry().snapshot()
+    )
+
+
+def _record_direct(tier: str, bucket: int, count: int = 1) -> None:
+    """Registry accounting for dispatches the bench drives through raw
+    jitted kernels (the headline path bypasses BatchVerifier._dispatch,
+    so it self-reports under bench_* tiers)."""
+    from tendermint_tpu.crypto.shape_registry import default_shape_registry
+
+    reg = default_shape_registry()
+    for _ in range(count):
+        reg.record_dispatch(tier, bucket)
 # bulk-tier batch: the dispatch floor on this executor is ~60-100 ms, so
 # throughput keeps rising with batch until device compute dominates
 # (measured r5: 8192 -> 78.5k, 16384 -> 111k, 32768 -> 115k sigs/s);
@@ -227,6 +259,7 @@ def main() -> None:
     )
 
     pub, rb, sb, kb, s_ok = _build_args(BATCH)
+    before_headline = _reg_snapshot()
 
     # one-time validator fixed-window table build (amortized over the
     # validator's life; the BatchVerifier caches these device-resident)
@@ -244,6 +277,10 @@ def main() -> None:
     dt_cached = _time_pipelined(
         cached_fn, tables, valid, idx, rb, sb, kb, s_ok
     )
+    # headline dispatches bypass BatchVerifier: self-report them
+    # (warm+ITERS latency rounds, warm+ITERS*8 pipelined, 1 table build)
+    _record_direct("bench_build", 128)
+    _record_direct("bench_big", BATCH, count=2 + ITERS + ITERS * 8)
     cached_rate = BATCH / dt_cached
     print(
         f"# cached-table path: {cached_rate:,.0f} sigs/s pipelined "
@@ -257,9 +294,11 @@ def main() -> None:
     # compile intermittently drops large programs, so failures here must
     # not lose the headline measurement
     generic_rate = None
+    before_generic = _reg_snapshot()
     try:
         generic_fn = jax.jit(verify_prehashed)
         dt_generic = _time_best(generic_fn, pub, rb, sb, kb, s_ok)
+        _record_direct("bench_generic", BATCH, count=1 + ITERS)
         generic_rate = BATCH / dt_generic
         print(
             f"# generic path: {generic_rate:,.0f} sigs/s "
@@ -282,6 +321,7 @@ def main() -> None:
                 "vs_baseline": round(
                     cached_rate / BASELINE_SERIAL_SIGS_PER_S, 3
                 ),
+                **_shape_stats(before_headline),
                 # the rest of the bench family (VERDICT r2 weak #7: one
                 # recorded metric left regressions in the other paths
                 # invisible); each entry is metric/value/unit/vs_baseline
@@ -297,6 +337,7 @@ def main() -> None:
                             "vs_baseline": round(
                                 generic_rate / BASELINE_SERIAL_SIGS_PER_S, 3
                             ),
+                            **_shape_stats(before_generic),
                         }
                     ]
                     if generic_rate
@@ -372,16 +413,19 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
         def tile10(x):
             return jnp.concatenate([x] * reps, axis=0)[:B10]
 
+        before = _reg_snapshot()
         args10 = tuple(tile10(a) for a in (idx, rb, sb, kb, s_ok))
         lat = _time_best(
             cached_fn, tables, tile10(valid), *args10
         )
+        _record_direct("bench_big", B10, count=1 + ITERS)
         out.append(
             {
                 "metric": "ed25519_commit10k_latency",
                 "value": round(lat * 1e3, 1),
                 "unit": "ms p50 (target 5)",
                 "vs_baseline": round(5.0 / (lat * 1e3), 4),
+                **_shape_stats(before),
             }
         )
     except Exception as e:
@@ -496,6 +540,7 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
             hb = h.to_bytes(4, "big") * 8
             bid = BlockID(hb, PartSetHeader(1, hb))
             entries.append((bid, h, sign_commit(vs_r, pvs_r, h, 0, bid)))
+        before = _reg_snapshot()
         verifier = BatchVerifier()
         verifier.warm([v.pub_key.data for v in vs_r.validators], bulk=True)
         assert all(
@@ -512,6 +557,7 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
                 "value": round(rate, 1),
                 "unit": "sigs/s (windowed multi-commit)",
                 "vs_baseline": round(rate / BASELINE_SERIAL_SIGS_PER_S, 3),
+                **_shape_stats(before),
             }
         )
     except Exception as e:
@@ -519,6 +565,7 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
 
     # --- light-client bisection (BASELINE config 5) ----------------------
     try:
+        before = _reg_snapshot()
         rate, n_sigs, dt = _bench_light_bisection()
         out.append(
             {
@@ -526,6 +573,7 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
                 "value": round(rate, 1),
                 "unit": f"sigs/s ({n_sigs} sigs, {dt*1e3:.0f} ms skip-verify)",
                 "vs_baseline": round(rate / BASELINE_SERIAL_SIGS_PER_S, 3),
+                **_shape_stats(before),
             }
         )
     except Exception as e:
@@ -533,6 +581,7 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
 
     # --- light bisection at 1/10 of the BASELINE config-5 shape ----------
     try:
+        before = _reg_snapshot()
         rate, reqs, dt = _bench_light_bisection_1k()
         out.append(
             {
@@ -543,6 +592,7 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
                     f"blocks fetched, {dt:.1f} s)"
                 ),
                 "vs_baseline": round(rate / BASELINE_SERIAL_SIGS_PER_S, 3),
+                **_shape_stats(before),
             }
         )
     except Exception as e:
@@ -550,6 +600,9 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
 
     # --- table-build cost per key: cold bulk warm vs cache hit -----------
     try:
+        # per-metric shape stats are computed INSIDE the helper at the
+        # cold/hit boundary (a wrapper snapshot here would stamp both
+        # metrics with the same cumulative delta)
         for m in _bench_table_build():
             out.append(m)
     except Exception as e:
@@ -557,6 +610,7 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
 
     # --- sustained throughput under validator-set churn ------------------
     try:
+        before = _reg_snapshot()
         rate, dt = _bench_churn_throughput()
         out.append(
             {
@@ -568,6 +622,7 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
                     "XLA programs pre-loaded)"
                 ),
                 "vs_baseline": round(rate / BASELINE_SERIAL_SIGS_PER_S, 3),
+                **_shape_stats(before),
             }
         )
     except Exception as e:
@@ -575,6 +630,7 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
 
     # --- vote-path latency through the micro-batcher ---------------------
     try:
+        # stats computed inside, per concurrency level
         for m in _bench_vote_latency():
             out.append(m)
     except Exception as e:
@@ -627,12 +683,16 @@ def _bench_table_build() -> list:
         for i in range(128)
     ]
     v = BatchVerifier(min_device_batch=0, bigtable_min=8)
+    before_cold = _reg_snapshot()
     t0 = time.perf_counter()
     v.warm(pubs, bulk=True)
     cold_ms = (time.perf_counter() - t0) * 1e3 / 128
+    cold_stats = _shape_stats(before_cold)
+    before_hit = _reg_snapshot()
     t0 = time.perf_counter()
     v.warm(pubs, bulk=True)
     hit_ms = (time.perf_counter() - t0) * 1e3 / 128
+    hit_stats = _shape_stats(before_hit)
     serial_ms = 1e3 / BASELINE_SERIAL_SIGS_PER_S
     return [
         {
@@ -640,12 +700,14 @@ def _bench_table_build() -> list:
             "value": round(cold_ms, 3),
             "unit": "ms/key (128-key bulk warm)",
             "vs_baseline": round(serial_ms / cold_ms, 5) if cold_ms else 0.0,
+            **cold_stats,
         },
         {
             "metric": "ed25519_table_build_hit_per_key",
             "value": round(hit_ms, 4),
             "unit": "ms/key (re-warm of cached keys)",
             "vs_baseline": round(serial_ms / hit_ms, 2) if hit_ms else 0.0,
+            **hit_stats,
         },
     ]
 
@@ -902,6 +964,7 @@ def _bench_vote_latency():
     votes = [(b"vote-%d" % i, pv.sign(b"vote-%d" % i)) for i in range(512)]
     batcher = VoteBatcher()
     lat: dict[int, list] = {}
+    stats: dict[int, dict] = {}  # per-concurrency shape/dispatch deltas
 
     async def one(i):
         t0 = time.perf_counter()
@@ -911,12 +974,14 @@ def _bench_vote_latency():
 
     async def run():
         for c in (1, 64, 512):
+            before = _reg_snapshot()
             # throwaway round first: each concurrency lands in a new
             # batch bucket whose one-time compile must not pollute p99
             await asyncio.gather(*(one(i) for i in range(c)))
             lat[c] = list(
                 await asyncio.gather(*(one(i) for i in range(c)))
             )
+            stats[c] = _shape_stats(before)
         batcher.stop()
 
     asyncio.run(run())
@@ -935,6 +1000,7 @@ def _bench_vote_latency():
                 "value": round(v, 1),
                 "unit": "ms",
                 "vs_baseline": round(baseline_ms / v, 3) if v else 0.0,
+                **stats[c],
             }
         )
     return out

@@ -268,11 +268,18 @@ class BlocksyncReactor(Reactor):
                     entries.append((fid, first.header.height, commit))
                 # device call off-loop: gossip/status handling stays live
                 # while XLA runs (and while any table build holds the
-                # big-tier lock)
+                # big-tier lock). The classed dispatch routes the batch
+                # through the process verify scheduler (blocksync
+                # priority: consensus votes preempt, and this window
+                # coalesces with light/evidence work into shared rounds)
+                from ..parallel.scheduler import default_dispatch
+
                 verdicts = await asyncio.get_running_loop().run_in_executor(
                     None,
                     lambda: base_vals.verify_commits_light(
-                        self.state.chain_id, entries
+                        self.state.chain_id,
+                        entries,
+                        verifier=default_dispatch("blocksync"),
                     ),
                 )
                 n_ok = 0
@@ -334,6 +341,8 @@ class BlocksyncReactor(Reactor):
                 if second.last_commit is None:
                     raise ValueError("second block has no last commit")
                 vals = self.state.validators
+                from ..parallel.scheduler import default_dispatch
+
                 await asyncio.get_running_loop().run_in_executor(
                     None,
                     lambda: vals.verify_commit_light(
@@ -341,6 +350,7 @@ class BlocksyncReactor(Reactor):
                         first_id,
                         first.header.height,
                         second.last_commit,
+                        verifier=default_dispatch("blocksync"),
                     ),
                 )
                 bls_datas = self._check_batch_data(

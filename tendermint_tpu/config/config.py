@@ -226,6 +226,51 @@ class TpuConfig:
 
 
 @dataclass
+class SchedulerConfig:
+    """Unified verification dispatch scheduler (parallel/scheduler.py):
+    one process-wide service coalescing every subsystem's signature
+    verification into shape-bucketed, priority-classed, pipelined
+    device dispatches. Priority classes are fixed:
+    consensus > evidence > blocksync > light."""
+
+    enable: bool = True
+    # max signature items coalesced into one device round (the measured
+    # bulk-tier throughput knee, PERF_ANALYSIS §10)
+    max_batch: int = 16384
+    # comma-separated canonical pad buckets, e.g. "8,64,512,2048,8192";
+    # "" = the built-in ladder (crypto/shape_registry)
+    bucket_ladder: str = ""
+    # ahead-of-time compile/load the ladder's verify programs on the
+    # node's warm thread at startup (~6 programs/tier; zero per-shape
+    # loads mid-height afterwards) and persist the manifest below.
+    # Off by default: short-lived/test nodes shouldn't pay the ladder.
+    prewarm: bool = False
+    prewarm_manifest: str = "data/prewarm_manifest.json"
+
+    def validate_basic(self) -> None:
+        if self.max_batch < 1:
+            raise ValueError("scheduler.max_batch must be >= 1")
+        ladder = self.ladder()
+        if ladder is not None and (not ladder or min(ladder) < 1):
+            raise ValueError(
+                f"scheduler.bucket_ladder must be positive ints, got "
+                f"{self.bucket_ladder!r}"
+            )
+
+    def ladder(self):
+        """Parsed bucket ladder, or None for the built-in default."""
+        s = self.bucket_ladder.strip()
+        if not s:
+            return None
+        try:
+            return tuple(int(x) for x in s.split(",") if x.strip())
+        except ValueError as e:
+            raise ValueError(
+                f"scheduler.bucket_ladder must be comma-separated ints: {e}"
+            ) from e
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # kv | null
 
@@ -262,6 +307,7 @@ _SECTIONS = {
     "consensus": ConsensusTimeoutsConfig,
     "sequencer": SequencerConfig,
     "tpu": TpuConfig,
+    "scheduler": SchedulerConfig,
     "tx_index": TxIndexConfig,
     "instrumentation": InstrumentationConfig,
 }
@@ -280,6 +326,7 @@ class Config:
     )
     sequencer: SequencerConfig = field(default_factory=SequencerConfig)
     tpu: TpuConfig = field(default_factory=TpuConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig

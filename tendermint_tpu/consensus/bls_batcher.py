@@ -38,6 +38,21 @@ class BLSBatcher(MicroBatcher):
         )
 
     def _verify_items(self, batch: list) -> list:
+        """Route the grouped pairing checks through the process dispatch
+        scheduler's private-engine lane when one is running (consensus
+        priority — BLS rounds then serialize with ed25519 device rounds
+        instead of contending for the backend), else verify directly.
+        Runs in an executor thread, so the blocking bridge is safe."""
+        from ..parallel.scheduler import default_scheduler
+
+        sched = default_scheduler()
+        if sched is not None:
+            return sched.submit_fn_sync(
+                batch, self._verify_groups, "consensus"
+            )
+        return self._verify_groups(batch)
+
+    def _verify_groups(self, batch: list) -> list:
         """Group by message hash, batch-verify each group."""
         groups: dict[bytes, list[int]] = {}
         for i, (_, msg, _) in enumerate(batch):

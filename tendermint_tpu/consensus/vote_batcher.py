@@ -17,7 +17,12 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..crypto.batch_verifier import BatchVerifier, SigItem, default_verifier
+from ..crypto.batch_verifier import (
+    BatchVerifier,
+    SigItem,
+    default_verifier,
+    is_default_verifier,
+)
 from ..libs.log import Logger
 from .microbatch import MicroBatcher
 
@@ -32,6 +37,12 @@ class VoteBatcher(MicroBatcher):
         # an ed25519 rejection only drops the one vote — False is safe
         super().__init__(max_batch=max_batch, logger=logger,
                          error_verdict=False)
+        # bound to the shared verifier (the common case) the batcher
+        # routes through the process dispatch scheduler, so vote batches
+        # coalesce with blocksync/light/evidence work under consensus
+        # priority; an explicitly-injected verifier (tests) keeps its
+        # private path
+        self._route_scheduler = is_default_verifier(verifier)
         self.verifier = verifier or default_verifier()
 
     async def submit(self, pubkey: bytes, msg: bytes, sig: bytes,
@@ -41,4 +52,13 @@ class VoteBatcher(MicroBatcher):
         return bool(verdict)
 
     def _verify_items(self, items: list) -> list:
+        # runs in an executor thread (microbatch.py) — the scheduler's
+        # blocking bridge is safe here and keeps the loop live
+        if self._route_scheduler:
+            from ..parallel.scheduler import default_dispatch
+
+            return [
+                bool(v)
+                for v in default_dispatch("consensus").verify(items)
+            ]
         return [bool(v) for v in self.verifier.verify(items)]

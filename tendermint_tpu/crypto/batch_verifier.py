@@ -44,10 +44,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..obs import default_tracer
 from ..ops import ed25519_batch
 from .ed25519 import L, challenge
+from .shape_registry import (
+    DEFAULT_BUCKET_LADDER,
+    ShapeRegistry,
+    default_shape_registry,
+)
 
 # Bucket sizes: small buckets for consensus latency (votes trickle in),
-# large for blocksync/light-client bulk replay.
-BUCKETS = (8, 32, 128, 512, 2048, 8192)
+# large for blocksync/light-client bulk replay. The canonical ladder now
+# lives in crypto/shape_registry (one process-wide source so the
+# scheduler, the prewarmer and every verifier agree); this alias keeps
+# the historical name importable.
+BUCKETS = DEFAULT_BUCKET_LADDER
 
 # max rows of the device-resident table caches. Small tier: radix-16 window
 # tables, 2 KiB/key. Big tier: fixed-window tables, 128 KiB/key as canonical
@@ -66,14 +74,10 @@ _TABLE_ROWS_MIN = 128
 
 
 def _bucket(n: int, multiple_of: int = 1) -> int:
-    """Smallest padded size >= n from BUCKETS, rounded up so the batch axis
-    divides evenly across `multiple_of` mesh shards."""
-    base = next((b for b in BUCKETS if b >= n), None)
-    if base is None:
-        q = BUCKETS[-1]
-        base = ((n + q - 1) // q) * q
-    m = multiple_of
-    return ((base + m - 1) // m) * m
+    """Smallest padded size >= n from the process bucket ladder, rounded
+    up so the batch axis divides evenly across `multiple_of` mesh
+    shards."""
+    return default_shape_registry().bucket_for(n, multiple_of)
 
 
 @dataclass(frozen=True)
@@ -82,6 +86,19 @@ class SigItem:
     msg: bytes
     sig: bytes  # 64 bytes
     key_type: str = "ed25519"
+
+
+class _PreparedBatch:
+    """Host-assembled batch whose device dispatch is deferred. `run()`
+    blocks for the verdict bitmap (len == n). The prepare/run split is
+    what lets parallel/scheduler overlap the next batch's host assembly
+    with the current batch's device round."""
+
+    __slots__ = ("n", "run")
+
+    def __init__(self, n: int, run):
+        self.n = n
+        self.run = run
 
 
 def _verify_cached_small(tables, tvalid, idx, rb, sb, kb, s_ok):
@@ -135,12 +152,17 @@ class _TableCache:
     micro-batcher calls verify() from an executor thread while the event
     loop verifies serially."""
 
-    def __init__(self, lock, build_fn, entry_shape, capacity, nshards):
+    def __init__(
+        self, lock, build_fn, entry_shape, capacity, nshards, registry=None,
+        tier="build",
+    ):
         self._lock = lock
         self._build_fn = build_fn
         self._entry_shape = entry_shape  # per-key table dims after the row
         self._capacity = capacity
         self._nshards = nshards
+        self._registry = registry or default_shape_registry()
+        self._tier = tier
         self._idx: dict[bytes, int] = {}
         self.tables: jnp.ndarray | None = None
         self.valid: jnp.ndarray | None = None
@@ -191,7 +213,10 @@ class _TableCache:
                 if abort is not None and abort.is_set():
                     return True  # partial warm is fine; ensure is idempotent
                 chunk = new[lo : lo + 512]
-                b = _bucket(len(chunk), multiple_of=self._nshards)
+                b = self._registry.bucket_for(
+                    len(chunk), multiple_of=self._nshards
+                )
+                self._registry.record_dispatch(self._tier, b)
                 arr = np.zeros((b, 32), dtype=np.uint8)
                 for i, pk in enumerate(chunk):
                     arr[i] = np.frombuffer(pk, dtype=np.uint8)
@@ -237,6 +262,7 @@ class BatchVerifier:
         table_cache_capacity: int = TABLE_CACHE_CAPACITY,
         device_challenge_min: int | None = None,
         bigtable_min: int = BIGTABLE_MIN,
+        shape_registry: ShapeRegistry | None = None,
     ):
         """min_device_batch: below this size the host CPU verifies serially
         — a device round-trip costs more than a handful of host verifies
@@ -255,9 +281,14 @@ class BatchVerifier:
         bigtable_min: batches >= this bucket size use doubling-free
         fixed-window tables (2.5x faster steady-state, ~64x build cost);
         smaller batches use cheap-to-build radix-16 tables so live vote
-        verification never stalls behind a table build."""
+        verification never stalls behind a table build.
+
+        shape_registry: where (tier, bucket) program shapes + dispatch
+        counts are recorded; defaults to the process-wide registry so
+        bench/test shape budgets see every verifier in the process."""
         self._mesh = mesh
         self._min_device_batch = min_device_batch
+        self._registry = shape_registry or default_shape_registry()
         self._device_challenge_min = device_challenge_min
         self._bigtable_min = bigtable_min
         big_impl = (
@@ -330,6 +361,8 @@ class BatchVerifier:
             (16, 4, 32),
             table_cache_capacity,
             self._nshards,
+            registry=self._registry,
+            tier="build_small",
         )
         self._big = _TableCache(
             threading.Lock(),
@@ -337,6 +370,8 @@ class BatchVerifier:
             (64, 16, 4, 32),
             table_cache_capacity,
             self._nshards,
+            registry=self._registry,
+            tier="build_big",
         )
 
     # --- table cache -------------------------------------------------------
@@ -375,15 +410,123 @@ class BatchVerifier:
         if bulk and not abort.is_set():
             self._big.ensure(eds, abort=abort)
 
+    def prewarm_buckets(
+        self,
+        buckets=None,
+        tiers: tuple[str, ...] = ("small", "big", "generic"),
+        abort=None,
+    ) -> list[dict]:
+        """Ahead-of-time compile/load the verify programs for the
+        canonical bucket ladder, so a (re)started node pays the
+        per-shape XLA program cost at assembly on the warm thread
+        instead of mid-height (PERF_ANALYSIS §10: ~10-30 s per program
+        load through the tunnel, 44 distinct shapes ≈ 206 s of a cold
+        bisect run). Each program executes once with fully-rejected
+        padded lanes (all-zero rows, s_ok False — verdict-inert by
+        construction), the exact shapes steady state dispatches: the
+        small/big tier split follows `bigtable_min`, and the table
+        operand uses the stores' initial row allocation.
+
+        Run AFTER the validator-table warm (the node's warm thread does):
+        the cached tiers' programs are also shaped by the table-store row
+        allocation, so prewarming against the LIVE stores compiles the
+        exact operand shapes steady state dispatches — stores grown by a
+        later rotation past the next power-of-two row rung recompile
+        those shapes once, a bounded ladder of their own. Known gap: the
+        big_msgs tier (device_challenge_min > 0) is additionally shaped
+        by the batch's message-length class and cannot be prewarmed
+        ahead of knowing it.
+
+        Returns one {tier, bucket, rows, seconds} entry per program
+        executed (tools/prewarm.py persists these as the prewarm
+        manifest). `abort` (threading.Event, default the verifier
+        shutdown flag) stops between programs — shutdown must not wait
+        out the ladder.
+        """
+        if abort is None:
+            abort = self.shutdown_event
+        ladder = tuple(buckets) if buckets else self._registry.ladder
+        rows_small = (
+            int(self._small.tables.shape[0])
+            if self._small.tables is not None
+            else _TABLE_ROWS_MIN
+        )
+        rows_big = (
+            int(self._big.tables.shape[0])
+            if self._big.tables is not None
+            else _TABLE_ROWS_MIN
+        )
+        small_tables = jnp.zeros((rows_small, 16, 4, 32), dtype=jnp.uint8)
+        big_tables = jnp.zeros((rows_big, 64, 16, 4, 32), dtype=jnp.uint8)
+        tvalid_small = jnp.zeros(rows_small, dtype=bool)
+        tvalid_big = jnp.zeros(rows_big, dtype=bool)
+        out: list[dict] = []
+        for raw_b in sorted(set(ladder)):
+            b = self._registry.bucket_for(
+                int(raw_b), multiple_of=self._nshards
+            )
+            if any(e["bucket"] == b for e in out):
+                continue  # ladder rungs that collapse after shard rounding
+            zeros32 = np.zeros((b, 32), dtype=np.uint8)
+            idx = jnp.asarray(np.zeros(b, dtype=np.int32))
+            s_ok = jnp.asarray(np.zeros(b, dtype=bool))
+            bucket_tier = "big" if b >= self._bigtable_min else "small"
+            for tier in tiers:
+                if abort is not None and abort.is_set():
+                    return out
+                if tier in ("small", "big") and tier != bucket_tier:
+                    continue  # steady state never runs this (tier, bucket)
+                t0 = time.perf_counter()
+                if tier == "small":
+                    rows = rows_small
+                    self._dispatch(
+                        self._small_fn, "small", b, b,
+                        small_tables, tvalid_small, idx,
+                        zeros32, zeros32, zeros32, s_ok,
+                    )
+                elif tier == "big":
+                    rows = rows_big
+                    self._dispatch(
+                        self._big_fn, "big", b, b,
+                        big_tables, tvalid_big, idx,
+                        zeros32, zeros32, zeros32, s_ok,
+                    )
+                elif tier == "generic":
+                    rows = 0
+                    self._dispatch(
+                        self._fn, "generic", b, b,
+                        zeros32, zeros32, zeros32, zeros32, s_ok,
+                    )
+                else:
+                    raise ValueError(f"unknown prewarm tier {tier!r}")
+                out.append(
+                    {
+                        "tier": tier,
+                        "bucket": int(b),
+                        "rows": rows,
+                        "seconds": round(time.perf_counter() - t0, 3),
+                    }
+                )
+        return out
+
     # --- verification ------------------------------------------------------
 
     def _dispatch(self, fn, tier: str, b: int, n: int, *args) -> np.ndarray:
         """Run one jitted verify program and block for the result, tracing
         the wall time as `crypto.jit_compile` on a shape's first dispatch
         (compile + execute) and `crypto.device_execute` afterwards."""
-        key = (tier, b)
+        # cached tiers' programs are also shaped by the table-store row
+        # allocation (arg 0; _TableCache grows it in powers of two) — a
+        # grown store is a NEW program even at the same batch bucket
+        rows = (
+            int(args[0].shape[0])
+            if tier in ("small", "big", "big_msgs")
+            else 0
+        )
+        key = (tier, b, rows)
         first = key not in self._seen_shapes
         self._seen_shapes.add(key)
+        self._registry.record_dispatch(tier, b, rows)
         tracer = default_tracer()
         if not tracer.enabled:
             return np.asarray(fn(*args))
@@ -407,58 +550,86 @@ class BatchVerifier:
         are partitioned per key type: ed25519 rows ride the device batch,
         other types verify on host, and the bitmap is re-interleaved.
         """
+        return self.prepare(items).run()
+
+    def _verify_mixed(self, items: list[SigItem], other_idx: list[int]):
+        """Mixed-key partition: ed25519 rows ride the device batch, other
+        types verify on host, and the bitmap is re-interleaved."""
+        n = len(items)
+        out = np.zeros(n, dtype=bool)
+        ed_idx = [
+            i for i, it in enumerate(items) if it.key_type == "ed25519"
+        ]
+        if ed_idx:
+            out[ed_idx] = self.verify([items[i] for i in ed_idx])
+        # secp256k1 rows: one native batched call (BASELINE config 4;
+        # the python loop is the no-compiler fallback inside)
+        secp_idx = [
+            i for i in other_idx if items[i].key_type == "secp256k1"
+        ]
+        if secp_idx:
+            import os as _os
+
+            if (
+                _os.environ.get("TM_TPU_SECP_DEVICE") == "1"
+                and len(secp_idx) >= 32
+            ):
+                # device kernel (SURVEY §2.2 secp row): real-silicon
+                # gated, like TM_TPU_MXU_GATHER — the native host
+                # batch wins on this harness's executor
+                verdicts = _verify_secp_device(
+                    [items[i] for i in secp_idx]
+                )
+            else:
+                from . import secp_native
+
+                verdicts = secp_native.verify_msgs_batch(
+                    [items[i].pubkey for i in secp_idx],
+                    [items[i].msg for i in secp_idx],
+                    [items[i].sig for i in secp_idx],
+                )
+            out[secp_idx] = verdicts
+        for i in other_idx:
+            if items[i].key_type != "secp256k1":
+                out[i] = self._verify_host_other(items[i])
+        return out
+
+    def prepare(self, items: list[SigItem]) -> "_PreparedBatch":
+        """Host-side assembly of one batch: partition decisions, bucket
+        padding, array fills and sign-bytes challenge hashing — the
+        ~70 us/sig host work the §10 profile attributed to the bulk
+        path. Returns a handle whose `run()` performs the device
+        dispatch (cache ensure/snapshot + jitted program) and blocks for
+        the verdicts. `verify()` is `prepare(items).run()`; the dispatch
+        scheduler splits the two so batch N+1's host assembly overlaps
+        batch N's device execution."""
         n = len(items)
         if n == 0:
-            return np.zeros(0, dtype=bool)
+            return _PreparedBatch(0, lambda: np.zeros(0, dtype=bool))
         other_idx = [
             i for i, it in enumerate(items) if it.key_type != "ed25519"
         ]
         if other_idx:
-            out = np.zeros(n, dtype=bool)
-            ed_idx = [
-                i for i, it in enumerate(items) if it.key_type == "ed25519"
-            ]
-            if ed_idx:
-                out[ed_idx] = self.verify([items[i] for i in ed_idx])
-            # secp256k1 rows: one native batched call (BASELINE config 4;
-            # the python loop is the no-compiler fallback inside)
-            secp_idx = [
-                i for i in other_idx if items[i].key_type == "secp256k1"
-            ]
-            if secp_idx:
-                import os as _os
-
-                if (
-                    _os.environ.get("TM_TPU_SECP_DEVICE") == "1"
-                    and len(secp_idx) >= 32
-                ):
-                    # device kernel (SURVEY §2.2 secp row): real-silicon
-                    # gated, like TM_TPU_MXU_GATHER — the native host
-                    # batch wins on this harness's executor
-                    verdicts = _verify_secp_device(
-                        [items[i] for i in secp_idx]
-                    )
-                else:
-                    from . import secp_native
-
-                    verdicts = secp_native.verify_msgs_batch(
-                        [items[i].pubkey for i in secp_idx],
-                        [items[i].msg for i in secp_idx],
-                        [items[i].sig for i in secp_idx],
-                    )
-                out[secp_idx] = verdicts
-            for i in other_idx:
-                if items[i].key_type != "secp256k1":
-                    out[i] = self._verify_host_other(items[i])
-            return out
-        if n < self._min_device_batch:
-            from . import ed25519 as host
-
-            return np.array(
-                [host.verify(it.pubkey, it.msg, it.sig) for it in items],
-                dtype=bool,
+            # mixed-key batches recurse through verify(); host-bound, so
+            # the work stays on the dispatch side
+            return _PreparedBatch(
+                n, lambda: self._verify_mixed(items, other_idx)
             )
-        b = _bucket(n, multiple_of=self._nshards)
+        if n < self._min_device_batch:
+
+            def _run_host() -> np.ndarray:
+                from . import ed25519 as host
+
+                return np.array(
+                    [
+                        host.verify(it.pubkey, it.msg, it.sig)
+                        for it in items
+                    ],
+                    dtype=bool,
+                )
+
+            return _PreparedBatch(n, _run_host)
+        b = self._registry.bucket_for(n, multiple_of=self._nshards)
         big = b >= self._bigtable_min
         device_hash = (
             big
@@ -503,7 +674,7 @@ class BatchVerifier:
         if not well_formed:
             # nothing to verify on device (malformed pubkey/sig lengths);
             # also keeps the lazy table stores untouched
-            return np.zeros(n, dtype=bool)
+            return _PreparedBatch(n, lambda: np.zeros(n, dtype=bool))
 
         if device_hash:
             from ..ops import sha512 as dev_sha512
@@ -511,66 +682,74 @@ class BatchVerifier:
             msg_buf, n_blocks = dev_sha512.pad_messages(
                 msgs, prefix_pairs=prefixes
             )
+        else:
+            msg_buf = n_blocks = None
 
-        cache = self._big if big else self._small
-        row_pubkeys = [(i, items[i].pubkey) for i in well_formed]
-        # Two attempts: a concurrent verify() can trigger the cache-reset
-        # path between ensure() and snapshot(), evicting our rows; on a
-        # second miss fall through to the generic path rather than
-        # mis-rejecting (or crashing on) valid signatures.
-        for _ in range(2):
-            if not cache.ensure([pk for _, pk in row_pubkeys]):
-                break  # cache cannot hold this batch: generic path
-            snap = cache.snapshot(row_pubkeys, b)
-            if snap is None:
-                continue
-            tables, tvalid, idx = snap
-            if device_hash:
-                out = self._dispatch(
-                    self._msgs_fn,
-                    "big_msgs",
-                    b,
-                    n,
-                    tables,
-                    tvalid,
-                    jnp.asarray(idx),
-                    rb,
-                    sb,
-                    jnp.asarray(msg_buf),
-                    jnp.asarray(n_blocks),
-                    jnp.asarray(s_ok),
-                )
-            elif big:
-                out = self._dispatch(
-                    self._big_fn, "big", b, n,
-                    tables, tvalid, jnp.asarray(idx), rb, sb, kb,
-                    jnp.asarray(s_ok),
-                )
-            else:
-                out = self._dispatch(
-                    self._small_fn, "small", b, n,
-                    tables, tvalid, jnp.asarray(idx), rb, sb, kb,
-                    jnp.asarray(s_ok),
-                )
+        def _run_device() -> np.ndarray:
+            cache = self._big if big else self._small
+            row_pubkeys = [(i, items[i].pubkey) for i in well_formed]
+            # Two attempts: a concurrent verify() can trigger the
+            # cache-reset path between ensure() and snapshot(), evicting
+            # our rows; on a second miss fall through to the generic path
+            # rather than mis-rejecting (or crashing on) valid signatures.
+            for _ in range(2):
+                if not cache.ensure([pk for _, pk in row_pubkeys]):
+                    break  # cache cannot hold this batch: generic path
+                snap = cache.snapshot(row_pubkeys, b)
+                if snap is None:
+                    continue
+                tables, tvalid, idx = snap
+                if device_hash:
+                    out = self._dispatch(
+                        self._msgs_fn,
+                        "big_msgs",
+                        b,
+                        n,
+                        tables,
+                        tvalid,
+                        jnp.asarray(idx),
+                        rb,
+                        sb,
+                        jnp.asarray(msg_buf),
+                        jnp.asarray(n_blocks),
+                        jnp.asarray(s_ok),
+                    )
+                elif big:
+                    out = self._dispatch(
+                        self._big_fn, "big", b, n,
+                        tables, tvalid, jnp.asarray(idx), rb, sb, kb,
+                        jnp.asarray(s_ok),
+                    )
+                else:
+                    out = self._dispatch(
+                        self._small_fn, "small", b, n,
+                        tables, tvalid, jnp.asarray(idx), rb, sb, kb,
+                        jnp.asarray(s_ok),
+                    )
+                return out[:n]
+
+            # cache full: generic path (decompress in-batch; host
+            # challenges — this fallback is the validator-churn edge,
+            # not the bulk path)
+            gkb = kb
+            if gkb is None:
+                gkb = np.zeros((b, 32), dtype=np.uint8)
+                for i in well_formed:
+                    it = items[i]
+                    k = challenge(it.sig[:32], it.pubkey, it.msg)
+                    gkb[i] = np.frombuffer(
+                        k.to_bytes(32, "little"), dtype=np.uint8
+                    )
+            pub = np.zeros((b, 32), dtype=np.uint8)
+            for i in well_formed:
+                pub[i] = np.frombuffer(items[i].pubkey, dtype=np.uint8)
+            out = self._dispatch(
+                self._fn, "generic", b, n, pub, rb, sb, gkb,
+                jnp.asarray(s_ok),
+            )
             return out[:n]
 
-        # cache full: generic path (decompress in-batch; host challenges —
-        # this fallback is the validator-churn edge, not the bulk path)
-        if kb is None:
-            kb = np.zeros((b, 32), dtype=np.uint8)
-            for i in well_formed:
-                it = items[i]
-                k = challenge(it.sig[:32], it.pubkey, it.msg)
-                kb[i] = np.frombuffer(
-                    k.to_bytes(32, "little"), dtype=np.uint8
-                )
-        pub = np.zeros((b, 32), dtype=np.uint8)
-        for i in well_formed:
-            pub[i] = np.frombuffer(items[i].pubkey, dtype=np.uint8)
-        out = self._dispatch(
-            self._fn, "generic", b, n, pub, rb, sb, kb, jnp.asarray(s_ok)
-        )
-        return out[:n]
+        return _PreparedBatch(n, _run_device)
 
     @staticmethod
     def _verify_host_other(it: SigItem) -> bool:
@@ -668,6 +847,14 @@ def default_verifier() -> BatchVerifier:
             device_challenge_min=dcm if dcm > 0 else None,
         )
     return _default
+
+
+def is_default_verifier(verifier) -> bool:
+    """True iff `verifier` is the process-wide default instance (or was
+    never constructed — None). The dispatch scheduler only takes over
+    callers bound to the shared verifier; an explicitly-injected one
+    (tests, bench isolation) keeps its private path."""
+    return verifier is None or verifier is _default
 
 
 def warm_validator_sets_in_executor(

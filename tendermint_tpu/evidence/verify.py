@@ -10,9 +10,21 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..crypto.batch_verifier import BatchVerifier, SigItem, default_verifier
+from ..crypto.batch_verifier import BatchVerifier, SigItem
 from ..types.evidence import DuplicateVoteEvidence, LightClientAttackEvidence
 from ..types.validator_set import ValidatorSet
+
+
+def _evidence_dispatch(verifier):
+    """Default evidence signature checks onto the process dispatch
+    scheduler under the evidence class (just below consensus priority —
+    conflicting votes are consensus-relevant but must not delay live
+    vote rounds)."""
+    if verifier is not None:
+        return verifier
+    from ..parallel.scheduler import default_dispatch
+
+    return default_dispatch("evidence")
 
 
 def verify_duplicate_vote(
@@ -42,7 +54,7 @@ def verify_duplicate_vote(
     if val_set.total_voting_power() != ev.total_voting_power:
         raise ValueError("total voting power does not match")
 
-    verifier = verifier or default_verifier()
+    verifier = _evidence_dispatch(verifier)
     key_type = getattr(val.pub_key, "type_name", "ed25519")
     ok = verifier.verify(
         [
@@ -99,7 +111,7 @@ def verify_light_client_attack(
     if header.hash() == trusted_header_hash:
         raise ValueError("conflicting block matches the trusted header")
 
-    verifier = verifier or default_verifier()
+    verifier = _evidence_dispatch(verifier)
     common_vals.verify_commit_light_trusting(
         chain_id, commit, 1, 3, verifier=verifier
     )

@@ -407,6 +407,38 @@ class RPCMetrics:
         )
 
 
+class SchedulerMetrics:
+    """parallel/scheduler.py — the unified verification dispatch
+    scheduler's queue/coalescing health, so the flight recorder and
+    Prometheus can attribute queue wait vs device time."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.queue_depth = reg.gauge(
+            "verify_queue_depth",
+            "Signature items queued in the dispatch scheduler",
+            ("klass",),
+        )
+        self.batch_fill_ratio = reg.gauge(
+            "verify_batch_fill_ratio",
+            "items/bucket of the most recent coalesced device dispatch",
+        )
+        self.dispatches = reg.counter(
+            "verify_dispatches_total",
+            "Device verify rounds dispatched by the scheduler",
+        )
+        self.dispatch_coalesced = reg.counter(
+            "verify_dispatch_coalesced_total",
+            "Dispatches that merged >= 2 submissions into one batch",
+        )
+        self.queue_wait_seconds = reg.histogram(
+            "verify_queue_wait_seconds",
+            "Submission enqueue to device-dispatch wait",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                     float("inf")),
+        )
+
+
 class EvidenceMetrics:
     def __init__(self, reg: Optional[Registry] = None):
         reg = reg or default_registry()

@@ -102,6 +102,18 @@ class LightClient:
 
         self.now_ns = now_ns or _t.time_ns
 
+    async def _off_loop(self, fn, *args, **kwargs):
+        """Run one blocking commit verification in an executor thread:
+        the device round must not freeze provider I/O, and the process
+        dispatch scheduler's blocking bridge only engages off the event
+        loop — this is what lets bisection batches coalesce with (and
+        yield priority to) consensus/blocksync verify work."""
+        import functools
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(fn, *args, **kwargs)
+        )
+
     # --- initialization (reference :267-402) --------------------------------
 
     async def initialize(self) -> LightBlock:
@@ -142,7 +154,7 @@ class LightClient:
         # 2/3 of its own validator set must have signed (reference :369)
         from .verifier import _verify_commit_full_power
 
-        _verify_commit_full_power(lb)
+        await self._off_loop(_verify_commit_full_power, lb)
         # cross-check the root with all witnesses (reference :1131)
         await self._compare_with_witnesses(lb)
         self.store.save(lb)
@@ -214,7 +226,8 @@ class LightClient:
         verified = trusted
         for h in range(trusted.height + 1, new_block.height):
             interim = await self._block_from_primary(h)
-            verify_adjacent(
+            await self._off_loop(
+                verify_adjacent,
                 verified,
                 interim,
                 self.trusting_period_ns,
@@ -223,7 +236,8 @@ class LightClient:
             )
             verified = interim
             trace.append(interim)
-        verify_adjacent(
+        await self._off_loop(
+            verify_adjacent,
             verified,
             new_block,
             self.trusting_period_ns,
@@ -244,7 +258,8 @@ class LightClient:
         trace = [trusted]
         while True:
             try:
-                _verify(
+                await self._off_loop(
+                    _verify,
                     verified,
                     block_cache[depth],
                     self.trusting_period_ns,
@@ -365,7 +380,8 @@ class LightClient:
         if common is None or diverged is None:
             return None
         try:
-            verify_non_adjacent(
+            await self._off_loop(
+                verify_non_adjacent,
                 common,
                 witness_block,
                 self.trusting_period_ns,

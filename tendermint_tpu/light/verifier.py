@@ -14,6 +14,18 @@ from .types import LightBlock
 DEFAULT_MAX_CLOCK_DRIFT_NS = 10 * 1_000_000_000
 
 
+def _light_dispatch(verifier):
+    """Default the commit verifies onto the process dispatch scheduler
+    under the light class: bisection batches then coalesce with (and
+    yield priority to) consensus/blocksync work instead of owning a
+    private path to the device."""
+    if verifier is not None:
+        return verifier
+    from ..parallel.scheduler import default_dispatch
+
+    return default_dispatch("light")
+
+
 class VerificationError(Exception):
     pass
 
@@ -47,6 +59,7 @@ def verify_adjacent(
     trusting_period_ns: int,
     now_ns: int,
     max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+    verifier=None,
 ) -> None:
     """untrusted.height == trusted.height + 1 (reference :93)."""
     if untrusted.height != trusted.height + 1:
@@ -58,7 +71,7 @@ def verify_adjacent(
             "untrusted validators hash != trusted next validators hash"
         )
     untrusted.validate_basic(trusted.header.chain_id)
-    _verify_commit_full_power(untrusted)
+    _verify_commit_full_power(untrusted, verifier=verifier)
 
 
 def verify_non_adjacent(
@@ -69,12 +82,14 @@ def verify_non_adjacent(
     trust_numerator: int = 1,
     trust_denominator: int = 3,
     max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+    verifier=None,
 ) -> None:
     """Skipping verification (reference :32): enough of the OLD set still
     signs the new header, and the new set has 2/3 on it."""
     if untrusted.height == trusted.height + 1:
         return verify_adjacent(
-            trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns
+            trusted, untrusted, trusting_period_ns, now_ns,
+            max_clock_drift_ns, verifier=verifier,
         )
     _common_checks(trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns)
     untrusted.validate_basic(trusted.header.chain_id)
@@ -84,19 +99,21 @@ def verify_non_adjacent(
             untrusted.commit,
             trust_numerator,
             trust_denominator,
+            verifier=_light_dispatch(verifier),
         )
     except ValueError as e:
         raise ErrNewHeaderTooFarAhead(str(e)) from e
-    _verify_commit_full_power(untrusted)
+    _verify_commit_full_power(untrusted, verifier=verifier)
 
 
-def _verify_commit_full_power(lb: LightBlock) -> None:
+def _verify_commit_full_power(lb: LightBlock, verifier=None) -> None:
     try:
         lb.validators.verify_commit_light(
             lb.header.chain_id,
             BlockID(lb.header.hash(), lb.commit.block_id.part_set_header),
             lb.height,
             lb.commit,
+            verifier=_light_dispatch(verifier),
         )
     except ValueError as e:
         raise VerificationError(f"invalid commit: {e}") from e
@@ -108,11 +125,13 @@ def verify(
     trusting_period_ns: int,
     now_ns: int,
     max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+    verifier=None,
 ) -> None:
     """Dispatch (reference Verify :135)."""
     if untrusted.height == trusted.height + 1:
         verify_adjacent(
-            trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns
+            trusted, untrusted, trusting_period_ns, now_ns,
+            max_clock_drift_ns, verifier=verifier,
         )
     else:
         verify_non_adjacent(
@@ -121,4 +140,5 @@ def verify(
             trusting_period_ns,
             now_ns,
             max_clock_drift_ns=max_clock_drift_ns,
+            verifier=verifier,
         )

@@ -355,6 +355,33 @@ class Node(Service):
                 ring_size=config.instrumentation.trace_ring_size,
             )
         )
+        # unified verification dispatch scheduler: every subsystem's
+        # device-verify path funnels through parallel/scheduler's
+        # default_dispatch(), so installing one here captures the vote
+        # batcher, blocksync replay, light bisection and evidence checks.
+        # The bucket-ladder override must land BEFORE the first
+        # default_verifier() dispatch (the registry owns pad sizes).
+        self.verify_scheduler = None
+        # the ladder governs pad buckets for EVERY verifier through the
+        # process shape registry, scheduler routing or not — apply it
+        # outside the enable gate
+        ladder = config.scheduler.ladder()
+        if ladder is not None:
+            from ..crypto.shape_registry import configure_default
+
+            configure_default(ladder)
+        if config.scheduler.enable:
+            from ..parallel.scheduler import (
+                VerifyScheduler,
+                set_default_scheduler,
+            )
+
+            self.verify_scheduler = set_default_scheduler(
+                VerifyScheduler(
+                    max_batch=config.scheduler.max_batch,
+                    logger=self.logger,
+                )
+            )
         consensus_metrics = ConsensusMetrics(self.metrics_registry)
         wal = WAL(
             config.wal_file, metrics=consensus_metrics, tracer=self.tracer
@@ -492,6 +519,11 @@ class Node(Service):
         ev = getattr(self.consensus.verifier, "shutdown_event", None)
         if ev is not None:
             ev.clear()
+        # verification dispatch service first: the moment any reactor
+        # verifies, its classed dispatch should coalesce (until started,
+        # default_dispatch degrades to direct dispatch — still correct)
+        if self.verify_scheduler is not None:
+            await self.verify_scheduler.start()
         await self.proxy_app.start()
         if self.indexer_service is not None:
             await self.indexer_service.start()
@@ -562,10 +594,52 @@ class Node(Service):
             import threading as _threading
 
             self._warm_abort = self.consensus.verifier.shutdown_event
+
+            def _warm_startup(
+                verifier=self.consensus.verifier,
+                abort=self._warm_abort,
+            ):
+                verifier.warm(pubs, key_types=ktypes, abort=abort)
+                # ahead-of-time bucket-ladder prewarm (the §10 fix for
+                # per-shape program loads landing mid-height): compile/
+                # load every verify program the ladder dispatches, then
+                # persist the manifest so operators can see what a
+                # restart pays (tools/prewarm.py builds/verifies the
+                # same artifact standalone)
+                if not self.config.scheduler.prewarm or abort.is_set():
+                    return
+                try:
+                    entries = verifier.prewarm_buckets(abort=abort)
+                    from ..crypto.shape_registry import (
+                        default_shape_registry,
+                    )
+                    import json as _json
+                    import time as _time
+
+                    manifest = {
+                        "created_unix": int(_time.time()),
+                        "ladder": list(default_shape_registry().ladder),
+                        "entries": entries,
+                    }
+                    path = self.config.path(
+                        self.config.scheduler.prewarm_manifest
+                    )
+                    os.makedirs(os.path.dirname(path), exist_ok=True)
+                    with open(path, "w") as f:
+                        _json.dump(manifest, f, indent=1)
+                    self.logger.info(
+                        "verify-program prewarm complete",
+                        programs=len(entries),
+                        seconds=round(
+                            sum(e["seconds"] for e in entries), 1
+                        ),
+                        manifest=path,
+                    )
+                except Exception as e:  # prewarm is an optimization
+                    self.logger.error("bucket prewarm failed", err=repr(e))
+
             self._warm_thread = _threading.Thread(
-                target=self.consensus.verifier.warm,
-                args=(pubs,),
-                kwargs={"key_types": ktypes, "abort": self._warm_abort},
+                target=_warm_startup,
                 name="verifier-warm",
             )
             self._warm_thread.start()
@@ -608,6 +682,8 @@ class Node(Service):
             ev = getattr(self.consensus.verifier, "shutdown_event", None)
             if ev is not None:
                 ev.set()
+            if self.verify_scheduler is not None:
+                await self.verify_scheduler.stop()
             raise
 
     async def _run_statesync(self) -> None:
@@ -674,6 +750,10 @@ class Node(Service):
         if self.sequencer_reactor.sequencer_started:
             await self.sequencer_reactor.on_stop()
         await self.switch.stop()
+        # after the reactors: queued verify work drains (futures resolve),
+        # then later submissions degrade to direct dispatch
+        if self.verify_scheduler is not None:
+            await self.verify_scheduler.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self.metrics_server is not None:

@@ -6,5 +6,18 @@ BatchVerifier (and any other batch-sharded consumer) shards over.
 """
 
 from .mesh import build_mesh, mesh_from_env
+from .scheduler import (
+    VerifyScheduler,
+    default_dispatch,
+    default_scheduler,
+    set_default_scheduler,
+)
 
-__all__ = ["build_mesh", "mesh_from_env"]
+__all__ = [
+    "build_mesh",
+    "mesh_from_env",
+    "VerifyScheduler",
+    "default_dispatch",
+    "default_scheduler",
+    "set_default_scheduler",
+]

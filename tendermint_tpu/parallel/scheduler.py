@@ -1,0 +1,534 @@
+"""Unified verification dispatch scheduler — one process-wide async
+service every device-verify caller submits signature work to.
+
+PERF_ANALYSIS §10: after the math was fast, the remaining losses were
+dispatch plumbing — churn throughput floor-bound at 21 *sequential*
+~110 ms single-batch dispatches with the host idle during each device
+round, and a cold bisect-1k spending ~206 s loading 44 distinct
+op-shape programs. Both are per-caller problems: the vote MicroBatcher,
+blocksync commit replay, light bisection and evidence checks each owned
+a private path to `BatchVerifier` and dispatched whatever ad-hoc batch
+they happened to hold. This scheduler replaces those private paths:
+
+- **shape-bucketed programs**: every dispatch pads to the canonical
+  ladder owned by crypto/shape_registry, so the whole node executes
+  from a handful of precompiled programs per tier (prewarmable at
+  assembly via `BatchVerifier.prewarm_buckets` / tools/prewarm.py);
+- **cross-subsystem coalescing with priority**: items from different
+  submitters merge into ONE padded device batch per round. Classes are
+  served in fixed priority order (consensus votes preempt the bulk
+  backfill families) while per-submitter FIFO is preserved — a
+  submission's verdicts resolve in the order its class queue received
+  them, and rounds complete strictly in dispatch order;
+- **pipelined host/device overlap**: while batch N executes on the
+  dispatch thread, batch N+1 is assembled, padded and sign-bytes
+  challenge-hashed on the prep thread (`BatchVerifier.prepare` /
+  `_PreparedBatch.run` split) — the host no longer idles through each
+  ~110 ms device round.
+
+Callers reach it through `default_dispatch(klass)`, which returns a
+classed adapter with the BatchVerifier.verify surface when a scheduler
+is installed and falls back to the shared verifier otherwise — so the
+same call sites work in tests, bench isolation, and full nodes. The
+adapter also degrades to direct dispatch when invoked ON an event-loop
+thread (blocking there would deadlock the service); executor-thread
+callers (blocksync windowed verify, the vote micro-batcher's verify
+thread, light bisection) get the full coalescing path.
+
+Reference counterpart: none — the reference verifies serially inside
+each subsystem (consensus/state.go:2274, blocksync/reactor.go:553,
+light/verifier.go:58). The committee-BFT batched-verification papers
+(PAPERS.md) make the case for amortizing fixed costs across callers;
+this is that amortization for the dispatch floor itself.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..crypto.batch_verifier import SigItem, default_verifier
+from ..crypto.shape_registry import default_shape_registry
+from ..libs.log import Logger, nop_logger
+from ..libs.metrics import SchedulerMetrics, default_metrics
+from ..obs import default_tracer
+
+# Priority classes, served strictly in this order when assembling a
+# round: live consensus votes must never queue behind a blocksync/light
+# backfill flood. Starvation the other way is structurally bounded —
+# every round takes whatever capacity consensus left (consensus load is
+# O(validators) per height, max_batch is 16k).
+CLASS_ORDER = ("consensus", "evidence", "blocksync", "light")
+
+DEFAULT_MAX_BATCH = 16384
+
+
+class _Submission:
+    """One caller's unit of work. Large submissions may be consumed
+    across several rounds (offset/remaining); verdicts accumulate into
+    one aligned array and the future resolves when the last slice's
+    round completes."""
+
+    __slots__ = (
+        "items", "klass", "n", "fn", "verdicts", "remaining", "offset",
+        "future", "t_enq", "failed",
+    )
+
+    def __init__(self, items, klass, future, fn=None):
+        self.items = items
+        self.klass = klass
+        self.n = len(items)
+        self.fn = fn  # non-None => private-engine lane (e.g. BLS groups)
+        self.verdicts = (
+            None if fn is not None else np.zeros(self.n, dtype=bool)
+        )
+        self.remaining = self.n
+        self.offset = 0
+        self.future = future
+        self.t_enq = time.perf_counter()
+        # set when a round carrying one of this submission's slices
+        # failed: the future already holds the exception, so any
+        # not-yet-dispatched remainder is dead work and must be dropped
+        # at the queue head instead of burning device rounds
+        self.failed = False
+
+
+class _ClassedVerifier:
+    """BatchVerifier.verify-surface adapter bound to one priority class.
+
+    Safe to hand anywhere a BatchVerifier is accepted (ValidatorSet
+    commit verification, evidence checks): `verify()` routes through the
+    scheduler from worker threads and degrades to the underlying
+    verifier when the scheduler isn't running or the caller is on an
+    event-loop thread."""
+
+    __slots__ = ("_sched", "_klass")
+
+    def __init__(self, sched: "VerifyScheduler", klass: str):
+        self._sched = sched
+        self._klass = klass
+
+    def verify(self, items: list[SigItem]) -> np.ndarray:
+        return self._sched.submit_sync(items, self._klass)
+
+    def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+        return bool(self.verify([SigItem(pubkey, msg, sig)])[0])
+
+    def warm(self, *args, **kwargs):
+        return self._sched.verifier.warm(*args, **kwargs)
+
+    @property
+    def shutdown_event(self):
+        return self._sched.verifier.shutdown_event
+
+
+class VerifyScheduler:
+    """The process-wide dispatch service. Lifecycle: construct anywhere,
+    `await start()` on the serving loop (node assembly does this in
+    on_start), `await stop()` to drain — queued submissions are still
+    dispatched, then the worker exits. Until started (and after stop)
+    every entry point degrades to direct dispatch on the wrapped
+    verifier, so non-node harnesses never block."""
+
+    def __init__(
+        self,
+        verifier=None,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        logger: Optional[Logger] = None,
+        metrics: Optional[SchedulerMetrics] = None,
+    ):
+        self.verifier = verifier or default_verifier()
+        self.max_batch = max(1, int(max_batch))
+        self.logger = logger or nop_logger()
+        self.metrics = metrics or default_metrics(SchedulerMetrics)
+        self._queues: dict[str, deque[_Submission]] = {
+            k: deque() for k in CLASS_ORDER
+        }
+        self._depth: dict[str, int] = {k: 0 for k in CLASS_ORDER}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._wakeup: Optional[asyncio.Event] = None
+        self._worker: Optional[asyncio.Task] = None
+        self._accepting = False
+        self._prep_pool: Optional[ThreadPoolExecutor] = None
+        self._dispatch_pool: Optional[ThreadPoolExecutor] = None
+        # telemetry for tests/debugging: recent rounds as
+        # {n, subs, classes, fill} dicts (bounded)
+        self.dispatch_log: deque = deque(maxlen=1024)
+
+    # --- lifecycle ---------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return (
+            self._accepting
+            and self._worker is not None
+            and not self._worker.done()
+        )
+
+    async def start(self) -> None:
+        if self.running:
+            return
+        self._loop = asyncio.get_running_loop()
+        self._wakeup = asyncio.Event()
+        # single-thread pools: prep and dispatch are each serial stages
+        # of a two-deep pipeline; the overlap IS the design, more
+        # threads would only fight over the one device
+        self._prep_pool = ThreadPoolExecutor(
+            1, thread_name_prefix="verify-prep"
+        )
+        self._dispatch_pool = ThreadPoolExecutor(
+            1, thread_name_prefix="verify-dispatch"
+        )
+        self._accepting = True
+        self._worker = self._loop.create_task(self._run())
+
+    async def stop(self) -> None:
+        """Clean drain: stop accepting, dispatch everything queued,
+        wait for the worker to exit."""
+        self._accepting = False
+        if self._wakeup is not None:
+            self._wakeup.set()
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            try:
+                await worker
+            except asyncio.CancelledError:
+                pass
+        for pool in (self._prep_pool, self._dispatch_pool):
+            if pool is not None:
+                pool.shutdown(wait=False)
+        self._prep_pool = self._dispatch_pool = None
+
+    # --- submission --------------------------------------------------------
+
+    async def submit(
+        self, items: list[SigItem], klass: str = "consensus"
+    ) -> np.ndarray:
+        """Queue items under `klass`; resolves to the aligned verdict
+        bitmap. Must be awaited on the scheduler's own loop (cross-
+        thread callers use submit_sync)."""
+        items = list(items)
+        if not items:
+            return np.zeros(0, dtype=bool)
+        if not self.running:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self.verifier.verify, items
+            )
+        return await self._enqueue(items, klass, fn=None)
+
+    async def submit_fn(
+        self, items: list, fn: Callable[[list], list],
+        klass: str = "consensus",
+    ):
+        """Private-engine lane: `fn(items)` runs as its own round on the
+        shared dispatch thread, under the same priority ordering — the
+        BLS batch-point batcher rides this so pairing checks and ed25519
+        rounds serialize instead of contending for the device."""
+        items = list(items)
+        if not items:
+            return []
+        if not self.running:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, fn, items
+            )
+        return await self._enqueue(items, klass, fn=fn)
+
+    async def _enqueue(self, items, klass, fn):
+        if klass not in self._queues:
+            klass = "blocksync"  # unknown classes ride the bulk lane
+        fut = self._loop.create_future()
+        sub = _Submission(items, klass, fut, fn=fn)
+        self._queues[klass].append(sub)
+        self._depth[klass] += sub.n
+        self.metrics.queue_depth.set(self._depth[klass], klass=klass)
+        self._wakeup.set()
+        return await fut
+
+    def submit_sync(
+        self, items: list[SigItem], klass: str = "consensus"
+    ) -> np.ndarray:
+        """Blocking submit for worker threads (blocksync's windowed
+        verify, the vote micro-batcher's executor thread). Degrades to
+        direct dispatch when the scheduler isn't running, when called on
+        an event-loop thread, or when the scheduled round fails."""
+        items = list(items)
+        loop = self._loop
+        if not self.running or loop is None or self._on_loop_thread():
+            return np.asarray(self.verifier.verify(items))
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                self.submit(items, klass), loop
+            )
+            return np.asarray(fut.result())
+        except Exception as e:
+            self.logger.error(
+                "scheduled verify failed; direct dispatch", err=repr(e)
+            )
+            return np.asarray(self.verifier.verify(items))
+
+    def submit_fn_sync(
+        self, items: list, fn: Callable[[list], list],
+        klass: str = "consensus",
+    ):
+        loop = self._loop
+        if not self.running or loop is None or self._on_loop_thread():
+            return fn(items)
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                self.submit_fn(items, fn, klass), loop
+            )
+            return fut.result()
+        except Exception as e:
+            self.logger.error(
+                "scheduled fn-lane verify failed; direct dispatch",
+                err=repr(e),
+            )
+            return fn(items)
+
+    @staticmethod
+    def _on_loop_thread() -> bool:
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return False
+        return True
+
+    def classed(self, klass: str) -> _ClassedVerifier:
+        """A BatchVerifier-shaped handle submitting under `klass`."""
+        return _ClassedVerifier(self, klass)
+
+    # --- the worker --------------------------------------------------------
+
+    def _take_round(self):
+        """Assemble one round from the class queues in priority order.
+        Returns None (nothing ready), ("fn", submission), or
+        ("sig", slices, total) where slices are (sub, lo, take) spans.
+        Per-class FIFO: a class's head submission is never bypassed by a
+        later one in the same class."""
+        slices: list[tuple[_Submission, int, int]] = []
+        total = 0
+        for klass in CLASS_ORDER:
+            q = self._queues[klass]
+            while q and total < self.max_batch:
+                sub = q[0]
+                if sub.failed:
+                    # an earlier slice's round failed: the caller already
+                    # saw the exception — discard the remainder
+                    q.popleft()
+                    self._note_taken(klass, sub.n - sub.offset)
+                    continue
+                if sub.fn is not None:
+                    if slices:
+                        # dispatch the coalesced sig batch first; this
+                        # fn round stays at its class head for the next
+                        # turn (FIFO within the class is preserved)
+                        break
+                    q.popleft()
+                    self._note_taken(klass, sub.n)
+                    return ("fn", sub)
+                take = min(sub.n - sub.offset, self.max_batch - total)
+                lo = sub.offset
+                sub.offset += take
+                slices.append((sub, lo, take))
+                total += take
+                self._note_taken(klass, take)
+                if sub.offset >= sub.n:
+                    q.popleft()
+                else:
+                    break  # round is full mid-submission
+        if not slices:
+            return None
+        return ("sig", slices, total)
+
+    def _note_taken(self, klass: str, n: int) -> None:
+        self._depth[klass] -= n
+        self.metrics.queue_depth.set(self._depth[klass], klass=klass)
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        inflight: Optional[asyncio.Task] = None
+        try:
+            while True:
+                round_ = self._take_round()
+                if round_ is None:
+                    if inflight is not None:
+                        await inflight
+                        inflight = None
+                        continue
+                    if not self._accepting:
+                        break
+                    self._wakeup.clear()
+                    if any(self._queues[k] for k in CLASS_ORDER):
+                        continue  # landed between take and clear
+                    await self._wakeup.wait()
+                    continue
+                run = await self._host_prep(loop, round_)
+                if run is None:
+                    continue  # prep failed; futures already resolved
+                # serialize device rounds: round N completes (and its
+                # verdicts resolve) before round N+1 dispatches — while
+                # N executes, the loop above already prepped N+1
+                if inflight is not None:
+                    await inflight
+                    inflight = None
+                inflight = loop.create_task(self._execute(round_, run))
+        except asyncio.CancelledError:
+            pass  # forced cancel (loop teardown): fall through to drain
+        finally:
+            if inflight is not None:
+                try:
+                    await inflight
+                except (asyncio.CancelledError, Exception):
+                    pass
+            self._fail_pending(RuntimeError("verify scheduler stopped"))
+
+    async def _host_prep(self, loop, round_):
+        """Stage 1 of the pipeline: host-side batch assembly (padding,
+        sign-bytes challenge hashing) on the prep thread. Returns the
+        device-run callable, or None after resolving failures."""
+        kind = round_[0]
+        if kind == "fn":
+            sub = round_[1]
+            return lambda: sub.fn(sub.items)
+        _, slices, total = round_
+        flat: list[SigItem] = []
+        for sub, lo, take in slices:
+            flat.extend(sub.items[lo : lo + take])
+        prep_fn = getattr(self.verifier, "prepare", None)
+        if prep_fn is None:
+            # plain .verify-only verifier (test stubs): no split, the
+            # whole call runs on the dispatch thread
+            return lambda: self.verifier.verify(flat)
+        t0 = time.perf_counter()
+        try:
+            prepared = await loop.run_in_executor(
+                self._prep_pool, prep_fn, flat
+            )
+        except Exception as e:
+            self.logger.error("verify host prep failed", err=repr(e))
+            self._fail_slices(slices, e)
+            return None
+        default_tracer().add_span(
+            "scheduler.host_prep",
+            t0,
+            time.perf_counter() - t0,
+            n=total,
+        )
+        return prepared.run
+
+    async def _execute(self, round_, run) -> None:
+        loop = asyncio.get_running_loop()
+        kind = round_[0]
+        tracer = default_tracer()
+        t0 = time.perf_counter()
+        try:
+            verdicts = await loop.run_in_executor(self._dispatch_pool, run)
+        except Exception as e:
+            self.logger.error("verify dispatch failed", err=repr(e))
+            if kind == "sig":
+                self._fail_slices(round_[1], e)
+            else:
+                sub = round_[1]
+                if not sub.future.done():
+                    sub.future.set_exception(e)
+            return
+        dur = time.perf_counter() - t0
+        self.metrics.dispatches.inc()
+        if kind == "fn":
+            sub = round_[1]
+            if not sub.future.done():
+                sub.future.set_result(verdicts)
+            self.dispatch_log.append(
+                {"n": sub.n, "subs": 1, "classes": [sub.klass], "fn": True}
+            )
+            tracer.add_span(
+                "scheduler.device_round", t0, dur,
+                n=sub.n, engine="fn", klass=sub.klass,
+            )
+            return
+        _, slices, total = round_
+        arr = np.asarray(verdicts)
+        off = 0
+        oldest = min(sub.t_enq for sub, _, _ in slices)
+        for sub, lo, take in slices:
+            sub.verdicts[lo : lo + take] = arr[off : off + take]
+            off += take
+            sub.remaining -= take
+            if sub.remaining == 0 and not sub.future.done():
+                self.metrics.queue_wait_seconds.observe(t0 - sub.t_enq)
+                sub.future.set_result(sub.verdicts)
+        n_subs = len({id(sub) for sub, _, _ in slices})
+        classes = sorted({sub.klass for sub, _, _ in slices})
+        registry = getattr(
+            self.verifier, "_registry", None
+        ) or default_shape_registry()
+        bucket = registry.bucket_for(total)
+        fill = total / bucket if bucket else 0.0
+        if n_subs >= 2:
+            self.metrics.dispatch_coalesced.inc()
+        self.metrics.batch_fill_ratio.set(round(fill, 4))
+        self.dispatch_log.append(
+            {"n": total, "subs": n_subs, "classes": classes,
+             "fill": round(fill, 4)}
+        )
+        tracer.add_span(
+            "scheduler.queue_wait", oldest, t0 - oldest, n=total
+        )
+        tracer.add_span(
+            "scheduler.device_round", t0, dur,
+            n=total, bucket=bucket, fill=round(fill, 3),
+            classes=",".join(classes), coalesced=n_subs,
+        )
+
+    # --- failure paths -----------------------------------------------------
+
+    @staticmethod
+    def _fail_slices(slices, exc: Exception) -> None:
+        for sub, _, _ in slices:
+            sub.failed = True  # _take_round drops any queued remainder
+            if not sub.future.done():
+                sub.future.set_exception(exc)
+
+    def _fail_pending(self, exc: Exception) -> None:
+        """Forced-cancel path only — a clean stop() drains instead."""
+        for klass in CLASS_ORDER:
+            q = self._queues[klass]
+            while q:
+                sub = q.popleft()
+                self._note_taken(klass, sub.n - sub.offset)
+                if not sub.future.done():
+                    sub.future.set_exception(exc)
+
+
+_default_scheduler: Optional[VerifyScheduler] = None
+
+
+def default_scheduler() -> Optional[VerifyScheduler]:
+    return _default_scheduler
+
+
+def set_default_scheduler(
+    sched: Optional[VerifyScheduler],
+) -> Optional[VerifyScheduler]:
+    """Install `sched` as the process default (node assembly; latest
+    wins, like the default tracer). None uninstalls."""
+    global _default_scheduler
+    _default_scheduler = sched
+    return sched
+
+
+def default_dispatch(klass: str = "consensus"):
+    """What callers verify against: the default scheduler's classed
+    adapter when one is installed (it self-degrades to direct dispatch
+    while not running), else the process-wide verifier. Every
+    subsystem's device-verify path funnels through here so one installed
+    scheduler captures the whole node."""
+    sched = _default_scheduler
+    if sched is not None:
+        return sched.classed(klass)
+    return default_verifier()

@@ -231,18 +231,37 @@ class ValidatorSet:
         commit: Commit,
         only_for_block: bool,
     ) -> tuple[list[SigItem], list[int]]:
-        """(items, indices): one SigItem per counted commit signature."""
+        """(items, indices): one SigItem per counted commit signature.
+
+        The per-commit (prefix, suffix) sign-bytes parts are built ONCE
+        ahead of the per-validator loop — within a commit only the
+        timestamp field differs, so each row is a cheap three-way concat
+        (the §10 commit-encode fix, hoisted; this gather is what every
+        commit-verify caller — consensus gossip, blocksync, light
+        client, evidence — runs per batch)."""
+        from .canonical import CanonicalVoteEncoder
+
         items, idxs = [], []
+        parts_for = commit._sign_bytes_parts(chain_id, True)
+        parts_nil = None  # lazily: absent in the light (ForBlock) paths
         for i, cs in enumerate(commit.signatures):
             if cs.is_absent():
                 continue
-            if only_for_block and not cs.for_block():
+            if cs.for_block():
+                prefix, suffix = parts_for
+            elif only_for_block:
                 continue
+            else:
+                if parts_nil is None:
+                    parts_nil = commit._sign_bytes_parts(chain_id, False)
+                prefix, suffix = parts_nil
             val = self.validators[i]
             items.append(
                 SigItem(
                     val.pub_key.data,
-                    commit.vote_sign_bytes(chain_id, i),
+                    CanonicalVoteEncoder.vote_from_parts(
+                        prefix, suffix, cs.timestamp_ns
+                    ),
                     cs.signature,
                     key_type=getattr(val.pub_key, "type_name", "ed25519"),
                 )
@@ -355,9 +374,14 @@ class ValidatorSet:
         own power. Signers are matched by address, not index."""
         if trust_denominator == 0:
             raise ValueError("trust level has zero denominator")
+        from .canonical import CanonicalVoteEncoder
+
         verifier = verifier or default_verifier()
         items, powers = [], []
         seen: set[bytes] = set()
+        # parts hoisted out of the per-validator loop (only ForBlock rows
+        # are gathered here, so one (prefix, suffix) covers every row)
+        prefix, suffix = commit._sign_bytes_parts(chain_id, True)
         for i, cs in enumerate(commit.signatures):
             if not cs.for_block():
                 continue
@@ -370,7 +394,9 @@ class ValidatorSet:
             items.append(
                 SigItem(
                     val.pub_key.data,
-                    commit.vote_sign_bytes(chain_id, i),
+                    CanonicalVoteEncoder.vote_from_parts(
+                        prefix, suffix, cs.timestamp_ns
+                    ),
                     cs.signature,
                     key_type=getattr(val.pub_key, "type_name", "ed25519"),
                 )

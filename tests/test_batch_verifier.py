@@ -150,3 +150,125 @@ def test_malformed_only_batch_rejects():
     ]
     got = BatchVerifier().verify(items)
     assert got.shape == (9,) and not got.any()
+
+
+def _adversarial_items():
+    """Valid + adversarial rows incl. non-canonical and small-order
+    inputs (the pad-inertness satellite's required coverage)."""
+    k = _keypairs(1, seed=b"pad")[0]
+    pub = k.public_key().data
+    msg = b"padded lane probe"
+    sig = k.sign(msg)
+    s_int = int.from_bytes(sig[32:], "little")
+    ident = (1).to_bytes(32, "little")  # small-order (identity) pubkey
+    s = 777
+    ident_sig = (
+        host.point_compress(host.scalar_mult(s, host.BASEPOINT))
+        + s.to_bytes(32, "little")
+    )
+    return [
+        SigItem(pub, msg, sig),  # valid
+        SigItem(pub, msg, sig[:32] + (s_int + host.L).to_bytes(32, "little")),
+        SigItem(pub, b"other", sig),  # wrong msg
+        SigItem(pub, msg, bytes(32) + sig[32:]),  # zero R
+        SigItem(ident, b"torsion", ident_sig),  # small-order pubkey
+        SigItem(host.P.to_bytes(32, "little"), msg, sig),  # y = p pubkey
+        SigItem(pub, msg, b"short"),  # malformed length
+    ]
+
+
+def test_pad_to_bucket_is_verdict_inert():
+    """Padded lanes never flip a real verdict: the same rows verified
+    alone (bucket 8) and embedded in a larger batch (bucket 32, i.e. a
+    different padded program + different pad-lane count) produce
+    bit-identical verdicts, equal to the unpadded serial host reference
+    — adversarial rows included. This is the tentpole's safety
+    obligation: cross-subsystem coalescing changes every batch's padding
+    but must never change an answer."""
+    adv = _adversarial_items()
+    want = [host.verify(it.pubkey, it.msg, it.sig) for it in adv]
+    # the module _verifier has min_device_batch=0, so this 7-item batch
+    # runs the bucket-8 DEVICE program — assert that, don't assume it
+    before = _verifier._registry.snapshot()
+    small = default_verifier().verify(adv)
+    after = _verifier._registry.snapshot()
+    assert (
+        after["device_dispatch_count"] > before["device_dispatch_count"]
+    ), "bucket-8 arm fell back to the host path"
+    assert small.tolist() == want
+
+    filler_keys = _keypairs(20, seed=b"fill")
+    filler = [
+        SigItem(k.public_key().data, b"fill%d" % i, k.sign(b"fill%d" % i))
+        for i, k in enumerate(filler_keys)
+    ]
+    big = default_verifier().verify(adv + filler)
+    assert big[: len(adv)].tolist() == want
+    assert big[len(adv):].all()
+    # and in a different position within the coalesced batch
+    mixed = default_verifier().verify(filler + adv)
+    assert mixed[len(filler):].tolist() == want
+
+
+def test_shape_budget_bounded_with_bit_identical_verdicts():
+    """The acceptance counter test: a node-lifetime's worth of ad-hoc
+    batch sizes runs from the bounded bucket ladder — ≤ 8 distinct
+    program shapes per tier on a fresh registry — while every verdict
+    stays bit-identical to the serial host reference."""
+    from tendermint_tpu.crypto.shape_registry import ShapeRegistry
+
+    reg = ShapeRegistry()
+    v = BatchVerifier(
+        min_device_batch=0, bigtable_min=1 << 30, shape_registry=reg
+    )
+    keys = _keypairs(16, seed=b"budget")
+    sizes = [1, 2, 5, 8, 9, 17, 31, 32, 33, 64, 100, 128]
+    for n in sizes:
+        items, want = [], []
+        for i in range(n):
+            k = keys[i % len(keys)]
+            msg = b"h%d-%d" % (n, i)
+            sig = k.sign(msg)
+            if i % 5 == 3:
+                sig = b"\x00" * 64  # forged row
+            items.append(SigItem(k.public_key().data, msg, sig))
+            want.append(host.verify(items[-1].pubkey, msg, sig))
+        assert v.verify(items).tolist() == want
+    # 12 ad-hoc sizes collapsed onto the ladder's small rungs, all at
+    # the initial 128-row table allocation (one program per rung)
+    assert reg.distinct_shapes("small") <= 8
+    assert reg.buckets_by_tier()["small"] == (8, 32, 128)
+    assert reg.shapes_by_tier()["small"] == (
+        (8, 128), (32, 128), (128, 128),
+    )
+    assert reg.dispatch_count() >= len(sizes)
+    for tier, shapes in reg.shapes_by_tier().items():
+        assert len(shapes) <= 8, f"tier {tier} exceeded budget: {shapes}"
+
+
+def test_prewarm_buckets_covers_ladder_and_is_inert():
+    """prewarm_buckets executes one program per (tier, ladder rung)
+    without touching the table caches or producing accepts; a
+    subsequent real verify reuses the recorded shapes (no new smalls)."""
+    from tendermint_tpu.crypto.shape_registry import ShapeRegistry
+
+    reg = ShapeRegistry(ladder=(8, 32))
+    v = BatchVerifier(
+        min_device_batch=0, bigtable_min=1 << 30, shape_registry=reg
+    )
+    entries = v.prewarm_buckets(tiers=("small", "generic"))
+    assert {(e["tier"], e["bucket"]) for e in entries} == {
+        ("small", 8), ("small", 32), ("generic", 8), ("generic", 32),
+    }
+    assert all(e["seconds"] >= 0 for e in entries)
+    small_before = reg.shapes_by_tier()["small"]
+    dispatches_before = reg.dispatch_count()
+    k = _keypairs(1, seed=b"pw")[0]
+    got = v.verify(
+        [SigItem(k.public_key().data, b"post-warm", k.sign(b"post-warm"))]
+    )
+    assert got.tolist() == [True]
+    # bucket 8 small was prewarmed: the verify added dispatches (incl.
+    # its one-time build_small table build) but no new SMALL-tier shape
+    assert reg.shapes_by_tier()["small"] == small_before
+    assert reg.dispatch_count() > dispatches_before

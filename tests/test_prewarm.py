@@ -1,0 +1,94 @@
+"""Prewarm manifest tooling + program-shape budget regression.
+
+`perf`-marked (and slow: device compiles): tier-1-adjacent, selected
+with `pytest -m perf`. Guards the §10 fix — the bench verify family
+must keep compiling from a bounded bucket ladder, and tools/prewarm.py
+must keep producing a manifest that covers it."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.perf, pytest.mark.slow]
+
+
+def test_build_manifest_and_budget(tmp_path):
+    from tools.prewarm import build_manifest, check_budget
+
+    manifest = build_manifest(ladder=(8, 32), tiers=("small", "generic"))
+    assert manifest["ladder"] == [8, 32]
+    assert {(e["tier"], e["bucket"]) for e in manifest["entries"]} == {
+        ("small", 8), ("small", 32), ("generic", 8), ("generic", 32),
+    }
+    assert check_budget(manifest, budget=8) == []
+    assert check_budget(manifest, budget=1)  # 2 shapes/tier > 1
+
+    # round-trips as the JSON artifact the node's warm thread writes
+    path = tmp_path / "prewarm_manifest.json"
+    path.write_text(json.dumps(manifest))
+    loaded = json.loads(path.read_text())
+    assert loaded["entries"] == manifest["entries"]
+
+
+def test_bench_verify_family_shape_budget():
+    """Regression: the verify shapes the bench family dispatches (vote
+    buckets, commit buckets, replay windows, bisection batches) stay
+    within a fixed per-tier program budget on a fresh registry."""
+    from tendermint_tpu.crypto import ed25519 as host
+    from tendermint_tpu.crypto.batch_verifier import BatchVerifier, SigItem
+    from tendermint_tpu.crypto.shape_registry import ShapeRegistry
+
+    reg = ShapeRegistry()
+    v = BatchVerifier(
+        min_device_batch=0, bigtable_min=1 << 30, shape_registry=reg
+    )
+    keys = [host.PrivKey.from_secret(b"fam%d" % i) for i in range(8)]
+    # the family's characteristic sizes: single votes, vote bursts,
+    # 128-validator commits, multi-commit replay windows
+    for n in (1, 4, 21, 64, 127, 128, 96, 33):
+        items = []
+        for i in range(n):
+            k = keys[i % len(keys)]
+            msg = b"fam-%d-%d" % (n, i)
+            items.append(SigItem(k.public_key().data, msg, k.sign(msg)))
+        assert v.verify(items).all()
+    shapes = reg.shapes_by_tier()
+    for tier, tier_shapes in shapes.items():
+        assert len(tier_shapes) <= 8, (
+            f"bench verify family exceeded the shape budget in tier "
+            f"{tier}: {tier_shapes}"
+        )
+    assert reg.buckets_by_tier()["small"] == (8, 32, 128)
+
+
+def test_prewarm_cli_smoke(tmp_path):
+    """tools/prewarm.py end-to-end: build then --verify on a tiny
+    ladder, both rc=0, manifest on disk."""
+    out = tmp_path / "m.json"
+    cmd = [
+        sys.executable,
+        "tools/prewarm.py",
+        "--out", str(out),
+        "--ladder", "8",
+        "--tiers", "small",
+    ]
+    env = {"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    import os
+
+    env.update({k: v for k, v in os.environ.items() if k not in env})
+    r = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=560, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    manifest = json.loads(out.read_text())
+    assert manifest["entries"][0]["tier"] == "small"
+    r2 = subprocess.run(
+        cmd + ["--verify", "--reload-threshold", "300"],
+        capture_output=True, text=True, timeout=560, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "verify OK" in r2.stdout

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Build + verify the verification-program prewarm manifest.
+
+PERF_ANALYSIS §10: per-process XLA program loads cost ~10-30 s EACH
+through the tunnelled executor, and a cold bisect-1k run spent ~206 s
+loading 44 distinct op-shape programs. The fix is two-sided: the
+canonical bucket ladder (crypto/shape_registry) bounds how many
+programs exist, and this tool loads them ahead of time so the
+persistent compile cache holds every shape a node dispatches —
+a restarted node then pays zero per-shape loads mid-height.
+
+Modes:
+
+  python tools/prewarm.py                      # build the manifest
+  python tools/prewarm.py --verify             # re-run; report per-
+                                               # bucket load times and
+                                               # fail on budget breach
+
+Build executes every (tier, bucket) verify program once with
+verdict-inert padded lanes (BatchVerifier.prewarm_buckets — the same
+routine the node's warm thread runs under [scheduler] prewarm=true) and
+writes {created_unix, ladder, entries:[{tier,bucket,seconds}]} JSON.
+Verify re-executes the manifest's ladder in a warmed-cache process: any
+entry slower than --reload-threshold seconds means the persistent cache
+is NOT absorbing that shape (regression), and the distinct-shape count
+must stay within --budget per tier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.libs.jax_cache import set_compile_cache_env  # noqa: E402
+
+set_compile_cache_env()
+
+DEFAULT_MANIFEST = "prewarm_manifest.json"
+
+
+def build_manifest(
+    ladder=None, tiers=("small", "big", "generic")
+) -> dict:
+    """Run the ladder prewarm on a fresh verifier + registry; returns
+    the manifest dict (entries carry per-program wall seconds — on a
+    cold cache that is compile+load, on a warm cache just load)."""
+    from tendermint_tpu.crypto.batch_verifier import BatchVerifier
+    from tendermint_tpu.crypto.shape_registry import (
+        DEFAULT_BUCKET_LADDER,
+        ShapeRegistry,
+    )
+
+    ladder = tuple(ladder) if ladder else DEFAULT_BUCKET_LADDER
+    registry = ShapeRegistry(ladder)
+    verifier = BatchVerifier(min_device_batch=0, shape_registry=registry)
+    t0 = time.perf_counter()
+    entries = verifier.prewarm_buckets(buckets=ladder, tiers=tiers)
+    return {
+        "created_unix": int(time.time()),
+        "ladder": list(registry.ladder),
+        "tiers": list(tiers),
+        "entries": entries,
+        "total_seconds": round(time.perf_counter() - t0, 3),
+        "shapes_by_tier": registry.shapes_by_tier(),
+    }
+
+
+def check_budget(manifest: dict, budget: int) -> list[str]:
+    """Per-tier distinct-shape budget violations (empty = pass). A
+    program's shape is (bucket, rows): the cached tiers' programs vary
+    with the table-store row allocation too."""
+    problems = []
+    by_tier: dict[str, set] = {}
+    for e in manifest["entries"]:
+        by_tier.setdefault(e["tier"], set()).add(
+            (e["bucket"], e.get("rows", 0))
+        )
+    for tier, shapes in sorted(by_tier.items()):
+        if len(shapes) > budget:
+            problems.append(
+                f"tier {tier}: {len(shapes)} distinct shapes > budget "
+                f"{budget}: {sorted(shapes)}"
+            )
+    return problems
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "--out", default=DEFAULT_MANIFEST, help="manifest path"
+    )
+    ap.add_argument(
+        "--ladder",
+        default="",
+        help="comma-separated bucket ladder (default: built-in)",
+    )
+    ap.add_argument(
+        "--tiers",
+        default="small,big,generic",
+        help="comma-separated tiers to prewarm",
+    )
+    ap.add_argument(
+        "--budget",
+        type=int,
+        default=8,
+        help="max distinct program shapes per tier",
+    )
+    ap.add_argument(
+        "--verify",
+        action="store_true",
+        help="re-run an existing manifest's ladder and report load times",
+    )
+    ap.add_argument(
+        "--reload-threshold",
+        type=float,
+        default=60.0,
+        help="--verify: per-program seconds above which the persistent "
+        "cache is judged to not be absorbing the shape",
+    )
+    args = ap.parse_args()
+
+    ladder = (
+        tuple(int(x) for x in args.ladder.split(",") if x.strip())
+        if args.ladder.strip()
+        else None
+    )
+    tiers = tuple(t.strip() for t in args.tiers.split(",") if t.strip())
+
+    if args.verify:
+        if not os.path.exists(args.out):
+            print(f"no manifest at {args.out}; run without --verify first")
+            return 1
+        with open(args.out) as f:
+            prior = json.load(f)
+        ladder = ladder or tuple(prior["ladder"])
+        tiers = tuple(prior.get("tiers", tiers))
+
+    manifest = build_manifest(ladder=ladder, tiers=tiers)
+    for e in manifest["entries"]:
+        print(
+            f"  {e['tier']:>8s}  bucket {e['bucket']:>6d}  "
+            f"rows {e.get('rows', 0):>5d}  {e['seconds']:7.2f}s"
+        )
+    print(
+        f"{len(manifest['entries'])} programs, "
+        f"{manifest['total_seconds']:.1f}s total"
+    )
+
+    rc = 0
+    problems = check_budget(manifest, args.budget)
+    for p in problems:
+        print(f"BUDGET VIOLATION: {p}")
+        rc = 1
+
+    if args.verify:
+        slow = [
+            e
+            for e in manifest["entries"]
+            if e["seconds"] > args.reload_threshold
+        ]
+        for e in slow:
+            print(
+                f"RELOAD REGRESSION: {e['tier']}/{e['bucket']} took "
+                f"{e['seconds']:.1f}s > {args.reload_threshold:.0f}s — "
+                "persistent cache is not absorbing this shape"
+            )
+            rc = 1
+        if not slow and not problems:
+            print("verify OK: every ladder program loads within threshold")
+    else:
+        with open(args.out, "w") as f:
+            json.dump(manifest, f, indent=1)
+        print(f"wrote {args.out}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
