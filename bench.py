@@ -258,16 +258,42 @@ def main() -> None:
         verify_prehashed_bigcache,
     )
 
-    pub, rb, sb, kb, s_ok = _build_args(BATCH)
-    before_headline = _reg_snapshot()
+    # First dispatch under the guard: the r4 artifact's tail was a raw
+    # `RuntimeError: Unable to initialize backend 'axon'` from the
+    # device_put below — the PROBE's bounded child had passed, but this
+    # process's own backend init failed at first use (the probe and the
+    # bench see different plugin states across the tunnel). Classify and
+    # degrade through the same structured-artifact path instead of
+    # letting the traceback become the artifact.
+    try:
+        pub, rb, sb, kb, s_ok = _build_args(BATCH)
+        before_headline = _reg_snapshot()
 
-    # one-time validator fixed-window table build (amortized over the
-    # validator's life; the BatchVerifier caches these device-resident)
-    t0 = time.perf_counter()
-    tables, valid_u = jax.jit(neg_pubkey_bigtable)(pub[:128])
-    tables = jax.block_until_ready(tables)
-    np.asarray(valid_u)  # force through the tunnel
-    build_t = time.perf_counter() - t0
+        # one-time validator fixed-window table build (amortized over the
+        # validator's life; the BatchVerifier caches these device-resident)
+        t0 = time.perf_counter()
+        tables, valid_u = jax.jit(neg_pubkey_bigtable)(pub[:128])
+        tables = jax.block_until_ready(tables)
+        np.asarray(valid_u)  # force through the tunnel
+        build_t = time.perf_counter() - t0
+    except RuntimeError as e:
+        if os.environ.get("TM_TPU_BENCH_CHILD") == "1":
+            raise  # the sanitized CPU child has no deeper fallback
+        from tendermint_tpu.chaos.backend_guard import (
+            BackendStatus,
+            classify_failure,
+        )
+
+        msg = str(e)[-800:]
+        _degrade(
+            BackendStatus(
+                available=False,
+                rc=1,
+                error=f"backend init failed at first dispatch: {msg}",
+                kind=classify_failure(msg, 1),
+            )
+        )
+        return
     reps = (BATCH + 127) // 128
     idx = jnp.asarray(np.tile(np.arange(128, dtype=np.int32), reps)[:BATCH])
     valid = jnp.tile(valid_u, (reps,))[:BATCH]
@@ -345,7 +371,8 @@ def main() -> None:
                 )
                 + _extra_metrics(
                     cached_fn, tables, valid, idx, rb, sb, kb, s_ok
-                ),
+                )
+                + _bench_commit_path(),
                 # where a height's wall time goes (p50/p95 per consensus
                 # step + WAL/store/verify spans) — the scalar above finally
                 # ships with its breakdown
@@ -353,6 +380,175 @@ def main() -> None:
             }
         )
     )
+
+
+def _bench_commit_path() -> list:
+    """Commit-path family (PERF_ANALYSIS §12): drive the same
+    single-validator chain serially and pipelined ([commit_pipeline])
+    over a REAL on-disk WAL, and report per-height finalize
+    critical-path ms and fsyncs-per-height before/after.
+
+    Serial `consensus_commit_seconds` covers save → end-height fsync →
+    apply (all on the critical path); pipelined covers save enqueue +
+    WAL barrier only — apply runs in the background finalization task,
+    which is exactly the slice the node stops paying before it may
+    enter H+1. vs_baseline is serial/pipelined (the speedup).
+
+    Blocks carry ~256 KB of txs (4-5 parts): the serial WAL fsyncs once
+    per internally-gossiped part, the group-commit path writes
+    proposal + all parts and shares one fsync — the 2-tx test-net shape
+    would hide exactly the cost production blocks pay."""
+    import asyncio
+    import tempfile
+
+    heights = 8
+
+    def run_variant(pipelined: bool) -> dict:
+        from tendermint_tpu.abci.client import LocalClient
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+        from tendermint_tpu.consensus.state_machine import (
+            ConsensusConfig,
+            ConsensusState,
+        )
+        from tendermint_tpu.consensus.wal import WAL, GroupCommitWAL
+        from tendermint_tpu.consensus.commit_pipeline import CommitPipeline
+        from tendermint_tpu.l2node.mock import MockL2Node
+        from tendermint_tpu.libs.metrics import ConsensusMetrics, Registry
+        from tendermint_tpu.state.execution import BlockExecutor
+        from tendermint_tpu.state.state import State
+        from tendermint_tpu.state.store import StateStore
+        from tendermint_tpu.store.block_store import (
+            BlockStore,
+            WriteBehindBlockStore,
+        )
+        from tendermint_tpu.store.kv import MemKV
+        from tests.helpers import make_genesis, make_validators
+
+        vs, pvs = make_validators(1)
+        genesis = make_genesis(vs)
+        metrics = ConsensusMetrics(
+            Registry("bench_" + ("piped" if pipelined else "serial"))
+        )
+        import shutil
+
+        wal_dir = tempfile.mkdtemp(prefix="bench_commit_wal_")
+        wal_path = os.path.join(wal_dir, "wal")
+
+        class _FatL2(MockL2Node):
+            """Deterministic ~256 KB blocks (4-5 parts each)."""
+
+            def request_block_data(self, height):
+                from tendermint_tpu.l2node.l2node import BlockData
+
+                bd = super().request_block_data(height)
+                txs = [
+                    b"fat-%d-%d=" % (height, i) + b"v" * 65200
+                    for i in range(4)
+                ]
+                return BlockData(txs=txs, l2_block_meta=bd.l2_block_meta)
+
+        async def run():
+            app = KVStoreApplication()
+            l2 = _FatL2()
+            state_store = StateStore(MemKV())
+            state = State.from_genesis(genesis)
+            state_store.bootstrap(state)
+            if pipelined:
+                bs = WriteBehindBlockStore(MemKV(), metrics=metrics)
+                wal = GroupCommitWAL(wal_path, metrics=metrics)
+                pipe = CommitPipeline(metrics=metrics)
+            else:
+                bs = BlockStore(MemKV())
+                wal = WAL(wal_path, metrics=metrics)
+                pipe = None
+            ex = BlockExecutor(state_store, bs, LocalClient(app), l2)
+            cs = ConsensusState(
+                ConsensusConfig.test_config(),
+                state,
+                ex,
+                bs,
+                l2,
+                priv_validator=pvs[0],
+                wal=wal,
+                metrics=metrics,
+                commit_pipeline=pipe,
+            )
+            await cs.start()
+            t0 = time.perf_counter()
+            await cs.wait_for_height(heights, timeout=120)
+            wall = time.perf_counter() - t0
+            await cs.stop()
+            bs.stop()
+            fsyncs = wal.fsync_count
+            wal.close()
+            commit_hist = metrics.commit_seconds._series.get(())
+            return {
+                "finalize_ms": round(
+                    commit_hist.sum / commit_hist.total * 1e3, 3
+                ),
+                "fsyncs_per_height": round(fsyncs / heights, 2),
+                "wall_ms_per_height": round(wall / heights * 1e3, 1),
+            }
+
+        try:
+            return asyncio.run(run())
+        finally:
+            shutil.rmtree(wal_dir, ignore_errors=True)
+
+    out = []
+    try:
+        serial = run_variant(False)
+        piped = run_variant(True)
+        out.append(
+            {
+                "metric": "commit_finalize_critical_path",
+                "value": piped["finalize_ms"],
+                "unit": (
+                    f"ms/height pipelined (serial "
+                    f"{serial['finalize_ms']} ms; save+apply overlapped "
+                    f"with height H+1)"
+                ),
+                "vs_baseline": round(
+                    serial["finalize_ms"] / piped["finalize_ms"], 2
+                )
+                if piped["finalize_ms"]
+                else 0.0,
+            }
+        )
+        out.append(
+            {
+                "metric": "wal_fsyncs_per_height",
+                "value": piped["fsyncs_per_height"],
+                "unit": (
+                    f"fsyncs/height pipelined (serial "
+                    f"{serial['fsyncs_per_height']}; group commit)"
+                ),
+                "vs_baseline": round(
+                    serial["fsyncs_per_height"]
+                    / max(piped["fsyncs_per_height"], 0.01),
+                    2,
+                ),
+            }
+        )
+        out.append(
+            {
+                "metric": "commit_height_wall",
+                "value": piped["wall_ms_per_height"],
+                "unit": (
+                    f"ms/height wall pipelined (serial "
+                    f"{serial['wall_ms_per_height']}; incl. "
+                    f"timeout_commit floor)"
+                ),
+                "vs_baseline": round(
+                    serial["wall_ms_per_height"]
+                    / max(piped["wall_ms_per_height"], 0.01),
+                    2,
+                ),
+            }
+        )
+    except Exception as e:
+        print(f"# commit-path family failed: {e}", file=sys.stderr)
+    return out
 
 
 def _bench_height_attribution():

@@ -271,6 +271,41 @@ class SchedulerConfig:
 
 
 @dataclass
+class CommitPipelineConfig:
+    """Pipelined commit path (consensus/commit_pipeline.py): overlap
+    WAL group-commit, write-behind block persistence and the ABCI/L2
+    apply with next-height consensus. Off: the serial reference
+    finalize (save → end-height fsync → apply → state save on the
+    critical path)."""
+
+    enable: bool = True
+    # extra group-commit coalescing window, seconds: how long the WAL
+    # flush thread waits for more records before the shared fsync.
+    # 0 (default) = natural group commit only — records arriving during
+    # an in-flight fsync ride the next one at no added latency; > 0
+    # trades barrier latency for fewer fsyncs (high-latency disks)
+    flush_interval: float = 0.0
+    # bound of the write-behind store's save queue (backpressure above
+    # it). The consensus/blocksync paths self-limit to ~1 pending save
+    # (apply barriers on block durability before the app commit), so
+    # this is headroom for deeper pipelining, not a steady-state knob.
+    max_inflight: int = 8
+
+    def validate_basic(self) -> None:
+        if self.flush_interval < 0:
+            raise ValueError(
+                "commit_pipeline.flush_interval cannot be negative"
+            )
+        if self.flush_interval > 1.0:
+            raise ValueError(
+                "commit_pipeline.flush_interval > 1s would stall "
+                "every durability barrier"
+            )
+        if self.max_inflight < 1:
+            raise ValueError("commit_pipeline.max_inflight must be >= 1")
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # kv | null
 
@@ -308,6 +343,7 @@ _SECTIONS = {
     "sequencer": SequencerConfig,
     "tpu": TpuConfig,
     "scheduler": SchedulerConfig,
+    "commit_pipeline": CommitPipelineConfig,
     "tx_index": TxIndexConfig,
     "instrumentation": InstrumentationConfig,
 }
@@ -327,6 +363,9 @@ class Config:
     sequencer: SequencerConfig = field(default_factory=SequencerConfig)
     tpu: TpuConfig = field(default_factory=TpuConfig)
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    commit_pipeline: CommitPipelineConfig = field(
+        default_factory=CommitPipelineConfig
+    )
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig

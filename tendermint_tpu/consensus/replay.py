@@ -129,9 +129,44 @@ class Handshaker:
             self.n_blocks_replayed += 1
 
         if state_height < store_height:
-            # the final block updates consensus state via the full pipeline
             block = self._block_store.load_block(store_height)
             meta = self._block_store.load_block_meta(store_height)
+            if app_height == store_height:
+                # pipeline crash window: the background apply got through
+                # ABCI Commit but died before the state save. The app
+                # (and L2 — delivery precedes app commit in apply order)
+                # already executed this block; rebuild the state record
+                # from the saved responses instead of double-executing.
+                blob = self._state_store.load_abci_responses(store_height)
+                if blob is None:
+                    # apply_block persists the responses BEFORE the app
+                    # commit, so app==store without a blob means a
+                    # pre-reorder crash image or a tampered store.
+                    # Falling through would re-execute block H against
+                    # an app that already committed it — silent app-hash
+                    # divergence. Refuse loudly instead.
+                    raise RuntimeError(
+                        f"app is at height {store_height} but no ABCI "
+                        "responses are stored for it; cannot rebuild "
+                        "state without double-executing the block — "
+                        "reset the app state (or restore a snapshot) "
+                        "and re-run"
+                    )
+                from ..state.execution import ABCIResponses
+
+                self.logger.info(
+                    "restoring state from saved responses",
+                    height=store_height,
+                )
+                self.n_blocks_replayed += 1
+                return self._executor.update_state_from_responses(
+                    state,
+                    meta.block_id,
+                    block,
+                    ABCIResponses.decode(blob),
+                    app_hash,
+                )
+            # the final block updates consensus state via the full pipeline
             self.logger.info("applying final block", height=store_height)
             state = await self._executor.apply_block(
                 state, meta.block_id, block

@@ -48,7 +48,7 @@ from .batch import BatchCache, get_batch_start
 from .height_vote_set import HeightVoteSet
 from .messages import BlockPartMessage, ProposalMessage, VoteMessage
 from .ticker import TimeoutInfo, TimeoutTicker
-from .wal import WAL, NilWAL, WALMessage
+from .wal import WAL, NilWAL, WALMessage, end_height_record
 
 
 class Step(enum.IntEnum):
@@ -153,6 +153,7 @@ class ConsensusState:
         tracer=None,
         logger: Optional[Logger] = None,
         now_ns: Callable[[], int] = time.time_ns,
+        commit_pipeline=None,
     ):
         self.config = config
         self.executor = executor
@@ -162,13 +163,17 @@ class ConsensusState:
         self.priv_validator = priv_validator
         self.event_bus = event_bus
         self.wal = wal or NilWAL()
+        # consensus/commit_pipeline.CommitPipeline, or None for the
+        # serial finalize path (reference behavior)
+        self.pipeline = commit_pipeline
         self.verifier = verifier or default_verifier()
         self.bls_signer = bls_signer
         self.upgrade_height = upgrade_height
         self.on_upgrade = on_upgrade
         self.evpool = evidence_pool
         self.metrics = metrics  # libs.metrics.ConsensusMetrics or None
-        self.tracer = tracer or default_tracer()
+        # is-None check: an empty Tracer is falsy (it has __len__)
+        self.tracer = default_tracer() if tracer is None else tracer
         self.logger = logger or nop_logger()
         self.now_ns = now_ns
         self._last_commit_walltime = 0.0
@@ -184,6 +189,10 @@ class ConsensusState:
         self.event_switch = EventSwitch()
 
         self.state: State = state  # committed state (height = last block)
+        # last height whose apply_block + state save fully completed;
+        # with the pipeline, self.state may be one height ahead
+        # (provisional) of this while a finalization task is in flight
+        self._applied_height = state.last_block_height
         self.rs = RoundState()
         self._privval_pubkey = None
 
@@ -249,12 +258,31 @@ class ConsensusState:
                 await self._receive_task
             except (asyncio.CancelledError, Exception):
                 pass
-        self.wal.flush_and_sync()
+        if self.pipeline is not None:
+            # in-flight apply completes (state save is part of it), then
+            # queued block saves drain before the final WAL sync
+            await self.pipeline.drain()
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.block_store.wait_durable
+                )
+            except Exception as e:
+                # a latched write-behind failure must not abort the stop
+                # sequence — it is already logged/latched for operators
+                self.logger.error(
+                    "block store drain failed at stop", err=repr(e)
+                )
+        try:
+            self.wal.flush_and_sync()
+        except Exception as e:
+            # same rationale: a latched WAL fsync failure is already
+            # fatal for liveness; stop must still tear down cleanly
+            self.logger.error("WAL sync failed at stop", err=repr(e))
         self._stopped.set()
 
     async def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
-        """Test/RPC hook: block until `height` is committed."""
-        if self.state.last_block_height >= height:
+        """Test/RPC hook: block until `height` is committed AND applied."""
+        if self._applied_height >= height:
             return
         ev = self._height_waiters.setdefault(height, asyncio.Event())
         await asyncio.wait_for(ev.wait(), timeout)
@@ -293,14 +321,49 @@ class ConsensusState:
             # message must not swallow an already-dequeued timeout or our
             # own internal message
             if internal_get in done:
-                msg, peer_id = internal_get.result()
+                batch = [internal_get.result()]
                 try:
-                    self._wal_write(msg, sync=True)
-                    await self._handle_msg(msg, peer_id)
+                    if self.pipeline is not None:
+                        # group commit at the consumer: drain every
+                        # already-queued internal message (a proposer
+                        # enqueues proposal + all parts at once), WAL-
+                        # write them all, and share ONE durability
+                        # barrier — awaited, so the loop keeps serving
+                        # the background finalization task while the
+                        # flush thread syncs
+                        while True:
+                            try:
+                                batch.append(
+                                    self.internal_msg_queue.get_nowait()
+                                )
+                            except asyncio.QueueEmpty:
+                                break
+                        for m, _ in batch:
+                            self._wal_write(m, sync=False)
+                        await self.wal.abarrier()
+                    else:
+                        self._wal_write(batch[0][0], sync=True)
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
-                    self.logger.error("internal msg failed", err=repr(e))
+                    # WAL write/fsync failure: the messages are NOT
+                    # durably logged, so they must not be acted on
+                    # (replay couldn't reproduce the transition — the
+                    # log-before-process invariant is the double-sign
+                    # guard). Drop the batch, keep the routine alive.
+                    self.logger.error(
+                        "internal msg WAL write failed; dropping",
+                        n=len(batch),
+                        err=repr(e),
+                    )
+                    batch = []
+                for msg, peer_id in batch:
+                    try:
+                        await self._handle_msg(msg, peer_id)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        self.logger.error("internal msg failed", err=repr(e))
             if peer_get in done:
                 msg, peer_id = peer_get.result()
                 try:
@@ -471,8 +534,20 @@ class ConsensusState:
         if self._is_proposal_complete():
             await self._enter_prevote(height, round_)
 
+    async def _ensure_applied(self) -> None:
+        """App-hash-future barrier: callers that consume apply results
+        (proposal header construction, header validation, the next
+        finalize) wait here for the in-flight background finalization;
+        everything else runs on the provisional state. No-op on the
+        serial path and once the future resolved."""
+        if self.pipeline is not None:
+            await self.pipeline.wait_applied()
+
     async def _decide_proposal(self, height: int, round_: int) -> None:
         """defaultDecideProposal (reference :1192): build or re-propose."""
+        # the proposal header carries app_hash / last_results_hash /
+        # next_validators_hash from the previous height's apply
+        await self._ensure_applied()
         rs = self.rs
         if rs.valid_block is not None:
             block, parts = rs.valid_block, rs.valid_block_parts
@@ -666,6 +741,9 @@ class ConsensusState:
     async def _do_prevote(self, height: int, round_: int) -> None:
         """defaultDoPrevote (reference :1406): locked block > valid
         proposal > nil."""
+        # header validation below checks app_hash/last_results_hash —
+        # apply results of the previous height
+        await self._ensure_applied()
         rs = self.rs
         if rs.locked_block is not None:
             await self._sign_add_vote(
@@ -742,6 +820,8 @@ class ConsensusState:
             return
         rs.step = Step.PRECOMMIT
         self._new_step()
+        # the lock branch validates the proposal block against state
+        await self._ensure_applied()
         prevotes = rs.votes.prevotes(round_)
         bid, ok = (
             prevotes.two_thirds_majority() if prevotes else (None, False)
@@ -865,16 +945,27 @@ class ConsensusState:
         await self._finalize_commit(height)
 
     async def _finalize_commit(self, height: int) -> None:
-        """finalizeCommit (reference :1785-1948)."""
+        """finalizeCommit (reference :1785-1948).
+
+        Serial path: save block → WAL end-height fsync → apply → state
+        save, all before entering H+1 (reference behavior). Pipelined
+        path (commit_pipeline): block save is enqueued on the
+        write-behind store, the WAL end-height barrier is awaited on the
+        group-commit flush thread, and apply + state save run as a
+        background finalization task — the state machine enters H+1 on
+        a provisional state immediately after the WAL barrier."""
         rs = self.rs
         precommits = rs.votes.precommits(rs.commit_round)
         bid, _ = precommits.two_thirds_majority()
         block, parts = rs.proposal_block, rs.proposal_block_parts
 
         block.validate_basic()
+        # the previous height's apply must have landed before this
+        # height's state copy / batch bookkeeping below
+        await self._ensure_applied()
         fail.fail_point()
         t_commit = time.perf_counter()
-        # save block + seen commit
+        # save block + seen commit (enqueue-only on the write-behind store)
         if self.block_store.height < height:
             seen_commit = precommits.make_commit()
             with self.tracer.span(
@@ -882,13 +973,18 @@ class ConsensusState:
             ):
                 t_save = time.perf_counter()
                 self.block_store.save_block(block, parts, seen_commit)
-                if self.metrics is not None:
+                if self.metrics is not None and self.pipeline is None:
+                    # pipelined saves report from the store worker
                     self.metrics.block_store_save_seconds.observe(
                         time.perf_counter() - t_save
                     )
         fail.fail_point()
         # WAL barrier: after this record, the height is decided
-        self.wal.write_end_height(height)
+        if self.pipeline is not None:
+            self.wal.write(end_height_record(height))
+            await self.wal.abarrier()
+        else:
+            self.wal.write_end_height(height)
         fail.fail_point()
 
         # collect BLS contributions for batch points (morph)
@@ -921,7 +1017,33 @@ class ConsensusState:
                         "dropping invalid BLS contribution at commit",
                         validator=v.validator_address.hex()[:12],
                     )
-        state_copy = self.state.copy()
+
+        upgrading = bool(
+            self.upgrade_height and height >= self.upgrade_height
+        )
+        base_state = self.state
+        if self.pipeline is not None and not upgrading:
+            # batch cache rollover (reference state.go:1902-1910) — needs
+            # only the block, so it stays on the decision path.
+            # Pipelined commit_seconds = the finalize CRITICAL PATH
+            # (save enqueue + WAL barrier); apply cost is attributed by
+            # the exec.apply_block span and pipeline_wait.
+            self.batch_cache.on_block_committed(block)
+            self._record_committed(t_commit, block, parts, pipelined=True)
+            self.pipeline.begin(
+                height,
+                lambda: self._apply_committed(
+                    height, bid, block, base_state, bls_datas
+                ),
+            )
+            self._update_to_state(
+                self._provisional_state(base_state, bid, block),
+                provisional=True,
+            )
+            self._schedule_round_0()
+            return
+
+        state_copy = base_state.copy()
         with self.tracer.span(
             "exec.apply_block", height=height, round=rs.round
         ):
@@ -929,30 +1051,16 @@ class ConsensusState:
                 state_copy, bid, block, bls_datas
             )
         fail.fail_point()
-        if self.metrics is not None:
-            self.metrics.commit_seconds.observe(
-                time.perf_counter() - t_commit
-            )
-            self.metrics.total_txs.inc(len(block.data.txs))
-            # the part set already knows the encoded size — never
-            # re-encode the block on the commit path just to measure it
-            self.metrics.block_size_bytes.observe(parts.byte_size)
-
         # batch cache rollover (reference state.go:1902-1910)
         self.batch_cache.on_block_committed(block)
-        self.logger.info(
-            "committed block",
-            height=height,
-            round=self.rs.round,
-            txs=len(block.data.txs),
-            batch_point=bool(block.header.batch_hash),
-        )
+        self._record_committed(t_commit, block, parts, pipelined=False)
 
         # upgrade switch (reference state.go:1921-1938 + upgrade/upgrade.go)
-        if self.upgrade_height and height >= self.upgrade_height:
+        if upgrading:
             self.logger.info("upgrade height reached; stopping BFT", height=height)
             self._running = False
             self.state = new_state
+            self._applied_height = height
             if self.on_upgrade is not None:
                 res = self.on_upgrade(new_state)
                 if asyncio.iscoroutine(res):
@@ -964,6 +1072,78 @@ class ConsensusState:
         self._notify_height(height)
         self._schedule_round_0()
 
+    def _record_committed(
+        self, t_commit: float, block, parts, pipelined: bool
+    ) -> None:
+        """Commit telemetry, identical for both finalize paths (only the
+        commit_seconds SCOPE differs: serial = full finalize, pipelined
+        = the critical path up to this call)."""
+        if self.metrics is not None:
+            self.metrics.commit_seconds.observe(
+                time.perf_counter() - t_commit
+            )
+            self.metrics.total_txs.inc(len(block.data.txs))
+            # the part set already knows the encoded size — never
+            # re-encode the block on the commit path just to measure it
+            self.metrics.block_size_bytes.observe(parts.byte_size)
+        self.logger.info(
+            "committed block (apply pipelined)"
+            if pipelined
+            else "committed block",
+            height=block.header.height,
+            round=self.rs.round,
+            txs=len(block.data.txs),
+            batch_point=bool(block.header.batch_hash),
+        )
+
+    def _provisional_state(self, state: State, bid: BlockID, block) -> State:
+        """The pre-apply view of the next height's State: everything
+        consensus needs to run H+1's rounds is already determined —
+        validators(H+1) = next_validators(H) — while apply-derived
+        fields (app_hash, last_results_hash, next_validators updates,
+        consensus-params updates) keep the previous height's values and
+        are only read behind the `_ensure_applied` barrier."""
+        next_validators = state.next_validators.copy()
+        next_validators.increment_proposer_priority(1)
+        return State(
+            chain_id=state.chain_id,
+            initial_height=state.initial_height,
+            last_block_height=block.header.height,
+            last_block_id=bid,
+            last_block_time_ns=block.header.time_ns,
+            validators=state.next_validators.copy(),
+            next_validators=next_validators,
+            last_validators=state.validators.copy(),
+            last_height_validators_changed=state.last_height_validators_changed,
+            consensus_params=state.consensus_params,
+            last_height_consensus_params_changed=(
+                state.last_height_consensus_params_changed
+            ),
+            last_results_hash=state.last_results_hash,
+            app_hash=state.app_hash,
+        )
+
+    async def _apply_committed(
+        self, height: int, bid: BlockID, block, base_state: State, bls_datas
+    ) -> State:
+        """The background finalization task body: ABCI/L2 apply + state
+        save, then swap the provisional state for the applied one BEFORE
+        the app-hash future resolves, so every awaiter observes the full
+        state."""
+        state_copy = base_state.copy()
+        with self.tracer.span("exec.apply_block", height=height):
+            new_state = await self.executor.apply_block(
+                state_copy, bid, block, bls_datas
+            )
+        fail.fail_point()
+        if self.rs.height == height + 1:
+            # still on the next height (always true: the next finalize
+            # sits behind _ensure_applied) — adopt apply-derived fields
+            self.state = new_state
+        self._applied_height = height
+        self._notify_height(height)
+        return new_state
+
     def _notify_height(self, height: int) -> None:
         ev = self._height_waiters.pop(height, None)
         if ev is not None:
@@ -972,9 +1152,16 @@ class ConsensusState:
             if h <= height:
                 self._height_waiters.pop(h).set()
 
-    def _update_to_state(self, state: State) -> None:
+    def _update_to_state(self, state: State, provisional: bool = False) -> None:
         """updateToState (reference :622): reset RoundState for the next
-        height."""
+        height. `provisional` marks the pipelined entry into H+1 before
+        apply completes — identical except that the applied-height
+        watermark (and wait_for_height) advances only when the
+        background finalization swaps in the real state."""
+        if not provisional:
+            self._applied_height = max(
+                self._applied_height, state.last_block_height
+            )
         if self.metrics is not None:
             self.metrics.height.set(state.last_block_height)
             if state.validators is not None:

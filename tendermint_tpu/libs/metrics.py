@@ -75,11 +75,40 @@ class Counter:
         return out
 
 
+class _InProgress:
+    """Context manager behind Gauge.track_inprogress: inc on enter, dec
+    on exit — replaces hand-rolled try/inc/finally/dec blocks around
+    in-flight work (scheduler queue, commit-pipeline depth)."""
+
+    __slots__ = ("_gauge", "_amount", "_labels")
+
+    def __init__(self, gauge: "Gauge", amount: float, labels: dict):
+        self._gauge = gauge
+        self._amount = amount
+        self._labels = labels
+
+    def __enter__(self) -> "_InProgress":
+        self._gauge.inc(self._amount, **self._labels)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._gauge.dec(self._amount, **self._labels)
+
+
 class Gauge(Counter):
     def set(self, value: float, **labels) -> None:
         key = tuple(labels.get(k, "") for k in self.label_names)
         with self._lock:
             self._values[key] = value
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def track_inprogress(
+        self, amount: float = 1.0, **labels
+    ) -> _InProgress:
+        """Count work in flight for the duration of a with-block."""
+        return _InProgress(self, amount, labels)
 
     def render(self) -> list[str]:
         out = [
@@ -313,6 +342,23 @@ class ConsensusMetrics:
             "consensus_quorum_prevote_delay_seconds",
             "Prevote-step start to +2/3 prevotes observed",
         )
+        # --- commit pipeline (consensus/commit_pipeline.py) --------------
+        self.commit_pipeline_depth = reg.gauge(
+            "consensus_commit_pipeline_depth",
+            "Background finalizations in flight (0 or 1)",
+        )
+        self.commit_pipeline_wait_seconds = reg.histogram(
+            "consensus_commit_pipeline_wait_seconds",
+            "Time consumers of apply results waited on the app-hash "
+            "future (the pipeline's observable critical-path cost)",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                     1.0, float("inf")),
+        )
+        self.wal_group_fsync_records = reg.histogram(
+            "consensus_wal_group_fsync_records",
+            "WAL records covered per group-commit fsync",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, float("inf")),
+        )
 
 
 class P2PMetrics:
@@ -416,7 +462,8 @@ class SchedulerMetrics:
         reg = reg or default_registry()
         self.queue_depth = reg.gauge(
             "verify_queue_depth",
-            "Signature items queued in the dispatch scheduler",
+            "Signature items in flight in the dispatch scheduler "
+            "(submitted, verdicts not yet resolved)",
             ("klass",),
         )
         self.batch_fill_ratio = reg.gauge(

@@ -229,8 +229,39 @@ class Node(Service):
                 return MemKV()
             return SqliteKV(os.path.join(config.db_dir, f"{name}.db"))
 
+        # --- observability handles (node.go:1062; needed by the stores
+        # below, so built before them) ---
+        from ..libs.metrics import ConsensusMetrics, default_registry
+        from .. import obs
+
+        self.metrics_registry = default_registry()
+        # flight recorder: installed as the process default so every seam
+        # without an explicit handle (batch verifier, p2p conns, chaos)
+        # lands in the SAME timeline as the consensus step spans
+        self.tracer = obs.set_default_tracer(
+            obs.Tracer(
+                enabled=(
+                    config.instrumentation.trace
+                    or os.environ.get("TM_TPU_TRACE") == "1"
+                ),
+                ring_size=config.instrumentation.trace_ring_size,
+            )
+        )
+        consensus_metrics = ConsensusMetrics(self.metrics_registry)
+
         self.state_store = StateStore(make_kv("state"))
-        self.block_store = BlockStore(make_kv("blockstore"))
+        if config.commit_pipeline.enable:
+            # write-behind persistence: saves ride a worker thread
+            from ..store.block_store import WriteBehindBlockStore
+
+            self.block_store = WriteBehindBlockStore(
+                make_kv("blockstore"),
+                max_inflight=config.commit_pipeline.max_inflight,
+                metrics=consensus_metrics,
+                tracer=self.tracer,
+            )
+        else:
+            self.block_store = BlockStore(make_kv("blockstore"))
         state = self.state_store.load()
         if state is None:
             state = State.from_genesis(self.genesis)
@@ -339,22 +370,6 @@ class Node(Service):
         )
 
         # --- consensus (node.go:460-501) ---
-        from ..libs.metrics import ConsensusMetrics, default_registry
-        from .. import obs
-
-        self.metrics_registry = default_registry()
-        # flight recorder: installed as the process default so every seam
-        # without an explicit handle (batch verifier, p2p conns, chaos)
-        # lands in the SAME timeline as the consensus step spans
-        self.tracer = obs.set_default_tracer(
-            obs.Tracer(
-                enabled=(
-                    config.instrumentation.trace
-                    or os.environ.get("TM_TPU_TRACE") == "1"
-                ),
-                ring_size=config.instrumentation.trace_ring_size,
-            )
-        )
         # unified verification dispatch scheduler: every subsystem's
         # device-verify path funnels through parallel/scheduler's
         # default_dispatch(), so installing one here captures the vote
@@ -382,10 +397,32 @@ class Node(Service):
                     logger=self.logger,
                 )
             )
-        consensus_metrics = ConsensusMetrics(self.metrics_registry)
-        wal = WAL(
-            config.wal_file, metrics=consensus_metrics, tracer=self.tracer
-        )
+        # commit pipeline (consensus/commit_pipeline.py): group-commit
+        # WAL + write-behind block store + background apply. All three
+        # are wired together — replay semantics are designed for the
+        # trio, and half a pipeline buys latency without the overlap.
+        self.commit_pipeline = None
+        if config.commit_pipeline.enable:
+            from ..consensus.commit_pipeline import CommitPipeline
+            from ..consensus.wal import GroupCommitWAL
+
+            wal = GroupCommitWAL(
+                config.wal_file,
+                metrics=consensus_metrics,
+                tracer=self.tracer,
+                flush_interval=config.commit_pipeline.flush_interval,
+            )
+            self.commit_pipeline = CommitPipeline(
+                metrics=consensus_metrics,
+                tracer=self.tracer,
+                logger=self.logger,
+            )
+        else:
+            wal = WAL(
+                config.wal_file, metrics=consensus_metrics,
+                tracer=self.tracer,
+            )
+        self.wal = wal
         self.consensus = ConsensusState(
             config.consensus.to_state_machine_config(),
             state,
@@ -402,6 +439,7 @@ class Node(Service):
             metrics=consensus_metrics,
             tracer=self.tracer,
             logger=self.logger,
+            commit_pipeline=self.commit_pipeline,
         )
         self.consensus_reactor = ConsensusReactor(
             self.consensus, logger=self.logger
@@ -750,6 +788,14 @@ class Node(Service):
         if self.sequencer_reactor.sequencer_started:
             await self.sequencer_reactor.on_stop()
         await self.switch.stop()
+        # pipeline teardown AFTER the reactors: a still-active blocksync
+        # may save/apply right up to switch.stop — only once nothing can
+        # write do we drain the write-behind save queue and stop the WAL
+        # flush thread
+        self.block_store.stop()
+        # unconditional: the plain WAL's close is flush+fd-close; the
+        # group WAL's additionally drains and joins its flush thread
+        self.wal.close()
         # after the reactors: queued verify work drains (futures resolve),
         # then later submissions degrade to direct dispatch
         if self.verify_scheduler is not None:

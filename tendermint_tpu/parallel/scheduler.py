@@ -149,7 +149,6 @@ class VerifyScheduler:
         self._queues: dict[str, deque[_Submission]] = {
             k: deque() for k in CLASS_ORDER
         }
-        self._depth: dict[str, int] = {k: 0 for k in CLASS_ORDER}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._wakeup: Optional[asyncio.Event] = None
         self._worker: Optional[asyncio.Task] = None
@@ -244,10 +243,10 @@ class VerifyScheduler:
         fut = self._loop.create_future()
         sub = _Submission(items, klass, fut, fn=fn)
         self._queues[klass].append(sub)
-        self._depth[klass] += sub.n
-        self.metrics.queue_depth.set(self._depth[klass], klass=klass)
         self._wakeup.set()
-        return await fut
+        # gauge scope = submitted until verdicts resolve (in flight)
+        with self.metrics.queue_depth.track_inprogress(sub.n, klass=klass):
+            return await fut
 
     def submit_sync(
         self, items: list[SigItem], klass: str = "consensus"
@@ -320,7 +319,6 @@ class VerifyScheduler:
                     # an earlier slice's round failed: the caller already
                     # saw the exception — discard the remainder
                     q.popleft()
-                    self._note_taken(klass, sub.n - sub.offset)
                     continue
                 if sub.fn is not None:
                     if slices:
@@ -329,14 +327,12 @@ class VerifyScheduler:
                         # turn (FIFO within the class is preserved)
                         break
                     q.popleft()
-                    self._note_taken(klass, sub.n)
                     return ("fn", sub)
                 take = min(sub.n - sub.offset, self.max_batch - total)
                 lo = sub.offset
                 sub.offset += take
                 slices.append((sub, lo, take))
                 total += take
-                self._note_taken(klass, take)
                 if sub.offset >= sub.n:
                     q.popleft()
                 else:
@@ -344,10 +340,6 @@ class VerifyScheduler:
         if not slices:
             return None
         return ("sig", slices, total)
-
-    def _note_taken(self, klass: str, n: int) -> None:
-        self._depth[klass] -= n
-        self.metrics.queue_depth.set(self._depth[klass], klass=klass)
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
@@ -500,7 +492,6 @@ class VerifyScheduler:
             q = self._queues[klass]
             while q:
                 sub = q.popleft()
-                self._note_taken(klass, sub.n - sub.offset)
                 if not sub.future.done():
                     sub.future.set_exception(exc)
 

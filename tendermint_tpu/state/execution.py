@@ -9,6 +9,7 @@ and the L2-driven validator-set diffing :309-360.
 
 from __future__ import annotations
 
+import asyncio
 import json
 from dataclasses import dataclass, field
 from typing import Optional
@@ -35,6 +36,11 @@ class ABCIResponses:
     deliver_txs: list[abci.ResponseDeliverTx] = field(default_factory=list)
     end_block: Optional[abci.ResponseEndBlock] = None
     begin_block: Optional[abci.ResponseBeginBlock] = None
+    # the MERGED (L2-over-app) validator updates apply_block actually
+    # used — round-tripped so crash recovery from a saved-responses
+    # record rebuilds the identical next validator set
+    val_updates: list = field(default_factory=list)
+    param_updates: Optional[dict] = None
 
     def results_hash(self) -> bytes:
         leaves = [
@@ -57,6 +63,11 @@ class ABCIResponses:
                     }
                     for r in self.deliver_txs
                 ],
+                "val_updates": [
+                    [t, data.hex(), power]
+                    for (t, data, power) in self.val_updates
+                ],
+                "param_updates": self.param_updates,
             }
         ).encode()
 
@@ -75,6 +86,16 @@ class ABCIResponses:
                         for e in r.get("events", [])
                     ],
                 )
+            )
+        out.val_updates = [
+            (t, bytes.fromhex(h), power)
+            for (t, h, power) in obj.get("val_updates", [])
+        ]
+        out.param_updates = obj.get("param_updates")
+        if out.param_updates is not None:
+            # _update_state reads param updates off end_block
+            out.end_block = abci.ResponseEndBlock(
+                consensus_param_updates=out.param_updates
             )
         return out
 
@@ -189,14 +210,40 @@ class BlockExecutor:
             state, block_id, block, abci_responses, val_updates
         )
 
+        # persist the responses — WITH the merged validator/param
+        # updates — BEFORE the app commit: if the (possibly background,
+        # commit-pipelined) apply crashes after the app commits but
+        # before the state save, the handshake rebuilds the identical
+        # state record from these instead of double-executing the block
+        # (Handshaker → update_state_from_responses)
+        abci_responses.val_updates = list(val_updates)
+        if (
+            abci_responses.end_block is not None
+            and abci_responses.end_block.consensus_param_updates
+        ):
+            abci_responses.param_updates = (
+                abci_responses.end_block.consensus_param_updates
+            )
+        self._state_store.save_abci_responses(
+            block.header.height, abci_responses.encode()
+        )
+        # durable block BEFORE app commit: with the write-behind store,
+        # block H's save may still be queued — if the app committed
+        # while the block was lost in a crash, restart would see
+        # app_height > store_height, a state no replay path can fill
+        # (re-driving H would double-execute it on the app). After this
+        # barrier the durable order is always block >= app >= state,
+        # and every crash window lands on an existing recovery path.
+        # Normally a no-op (the save landed while txs executed); awaited
+        # off-loop so a backlogged disk never stalls the event loop.
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._block_store.wait_durable, block.header.height
+        )
         # ABCI Commit → app hash for the NEXT block
         res = await self._app.commit()
         fail.fail_point()  # crash after app commit, before state save
         new_state.app_hash = res.data
 
-        self._state_store.save_abci_responses(
-            block.header.height, abci_responses.encode()
-        )
         self._state_store.save(new_state)
         fail.fail_point()  # crash after state save
 
@@ -204,8 +251,15 @@ class BlockExecutor:
             self._evpool.update(new_state, block.evidence)
         if res.retain_height > 0:
             try:
-                self._block_store.prune_blocks(res.retain_height)
-                self._state_store.prune_states(res.retain_height)
+                # off-loop: pruning scans/deletes KV ranges and (on the
+                # write-behind store) barriers on queued saves
+                def _prune(h=res.retain_height):
+                    self._block_store.prune_blocks(h)
+                    self._state_store.prune_states(h)
+
+                await asyncio.get_running_loop().run_in_executor(
+                    None, _prune
+                )
             except ValueError:
                 pass
 
@@ -332,6 +386,31 @@ class BlockExecutor:
             last_results_hash=abci_responses.results_hash(),
             app_hash=state.app_hash,  # replaced after ABCI Commit
         )
+
+    def update_state_from_responses(
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        responses: ABCIResponses,
+        app_hash: bytes,
+    ) -> State:
+        """Handshake path for 'app committed, state save lost' (the
+        window the pipelined background apply widens): rebuild and
+        persist the state record from the height's SAVED ABCI responses
+        and the app's reported hash, without double-executing the block
+        against the app or re-delivering it to the L2 node (both already
+        have it — apply order puts app commit after L2 delivery). The
+        responses blob carries the merged validator/param updates apply
+        actually used (saved pre-commit), so validator-change heights
+        rebuild the identical next set (reference analog: mock-app
+        replayBlock, replay.go:414-440)."""
+        new_state = self._update_state(
+            state, block_id, block, responses, responses.val_updates
+        )
+        new_state.app_hash = app_hash
+        self._state_store.save(new_state)
+        return new_state
 
     async def exec_commit_block(self, state: State, block: Block) -> bytes:
         """Replay helper: execute a stored block against the app without

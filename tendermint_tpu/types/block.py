@@ -393,6 +393,13 @@ class Block:
     data: Data = field(default_factory=Data)
     evidence: list = field(default_factory=list)
     last_commit: Optional[Commit] = None
+    # memoized (part_size, PartSet): chunking + merkle-proving the
+    # encoded block is the priciest host hash on the commit/gossip path
+    # and callers re-derive it per call (blocksync window + fallback,
+    # block_id()); mutators (fill_header, set_batch_point) invalidate
+    _part_set: Optional[tuple[int, PartSet]] = field(
+        default=None, compare=False, repr=False
+    )
 
     def hash(self) -> bytes:
         return self.header.hash()
@@ -410,12 +417,14 @@ class Block:
         self.data.l2_batch_header = batch_header
         self.data._hash = None
         self.header._hash = None
+        self._part_set = None
         self.header.data_hash = self.data.hash()
 
     def fill_header(self) -> None:
         """Computes the derived header hashes from contents
         (reference Block.fillHeader, types/block.go)."""
         self.header._hash = None
+        self._part_set = None
         if not self.header.last_commit_hash and self.last_commit is not None:
             self.header.last_commit_hash = self.last_commit.hash()
         if not self.header.data_hash:
@@ -426,7 +435,12 @@ class Block:
             )
 
     def make_part_set(self, part_size: int = 65536) -> PartSet:
-        return PartSet.from_data(self.encode(), part_size)
+        cached = self._part_set
+        if cached is not None and cached[0] == part_size:
+            return cached[1]
+        ps = PartSet.from_data(self.encode(), part_size)
+        self._part_set = (part_size, ps)
+        return ps
 
     def block_id(self, part_set: Optional[PartSet] = None) -> BlockID:
         ps = part_set or self.make_part_set()

@@ -1,0 +1,802 @@
+"""Commit-pipeline tests: group-commit WAL, write-behind block store,
+pipelined finalize equivalence, and crash-recovery at every pipeline
+stage boundary (chaos-marked).
+
+Crash simulation: a FreezableKV drops writes after the test "pulls the
+plug", so the durable snapshot a restart sees is exactly what a real
+crash would leave — WAL end-height written (real fsynced file), block
+save and/or state save lost. The restarted node must converge to the
+identical app hash and state as the serial (unpipelined) path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus.commit_pipeline import CommitPipeline
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state_machine import (
+    ConsensusConfig,
+    ConsensusState,
+)
+from tendermint_tpu.consensus.wal import (
+    GroupCommitWAL,
+    NilWAL,
+    WAL,
+    WALMessage,
+    decode_records,
+)
+from tendermint_tpu.l2node.mock import MockL2Node
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import (
+    BlockStore,
+    WriteBehindBlockStore,
+)
+from tendermint_tpu.store.kv import MemKV
+
+from tests.helpers import make_genesis, make_validators
+
+
+# --- crash plumbing ---------------------------------------------------------
+
+
+class FreezableKV:
+    """MemKV wrapper whose writes can be 'lost': after freeze(), set/
+    write_batch silently drop — the durable image stays at the freeze
+    point, exactly like writes still queued at crash time."""
+
+    def __init__(self, inner=None, freeze_batches_only: bool = False):
+        self.inner = inner or MemKV()
+        self.frozen = False
+        # freeze only write_batch (multi-key saves) while single-key
+        # set() still lands — carves the "responses saved, state record
+        # lost" mid-apply window
+        self.freeze_batches_only = freeze_batches_only
+
+    def freeze(self) -> None:
+        self.frozen = True
+
+    def get(self, key):
+        return self.inner.get(key)
+
+    def set(self, key, value):
+        if self.frozen and not self.freeze_batches_only:
+            return
+        self.inner.set(key, value)
+
+    def delete(self, key):
+        if self.frozen:
+            return
+        self.inner.delete(key)
+
+    def write_batch(self, sets, deletes):
+        if self.frozen:
+            return
+        self.inner.write_batch(sets, deletes)
+
+    def iterate(self, start=b"", end=None):
+        return self.inner.iterate(start, end)
+
+    def close(self):
+        self.inner.close()
+
+
+def _build_node(
+    genesis,
+    pv,
+    wal_path,
+    *,
+    pipelined: bool,
+    app=None,
+    l2=None,
+    block_kv=None,
+    state_kv=None,
+    tracer=None,
+    metrics=None,
+):
+    """One consensus node over explicit stores (restartable)."""
+    app = app or KVStoreApplication()
+    l2 = l2 or MockL2Node()
+    block_kv = block_kv if block_kv is not None else MemKV()
+    state_kv = state_kv if state_kv is not None else MemKV()
+    state_store = StateStore(state_kv)
+    if pipelined:
+        block_store = WriteBehindBlockStore(
+            block_kv, max_inflight=4, metrics=metrics, tracer=tracer
+        )
+        wal = GroupCommitWAL(
+            wal_path, flush_interval=0.001, metrics=metrics, tracer=tracer
+        )
+        pipeline = CommitPipeline(metrics=metrics, tracer=tracer)
+    else:
+        block_store = BlockStore(block_kv)
+        wal = WAL(wal_path)
+        pipeline = None
+    state = state_store.load()
+    if state is None:
+        state = State.from_genesis(genesis)
+        state_store.bootstrap(state)
+    executor = BlockExecutor(
+        state_store, block_store, LocalClient(app), l2
+    )
+    cs = ConsensusState(
+        ConsensusConfig.test_config(),
+        state,
+        executor,
+        block_store,
+        l2,
+        priv_validator=pv,
+        wal=wal,
+        commit_pipeline=pipeline,
+    )
+    return cs, app, l2, block_store, state_store, executor
+
+
+async def _handshake(cs, genesis, executor, state_store, block_store):
+    hs = Handshaker(state_store, block_store, genesis, executor)
+    cs.state = await hs.handshake(cs.state)
+    return hs
+
+
+# --- group-commit WAL -------------------------------------------------------
+
+
+pytestmark = pytest.mark.pipeline
+
+
+def test_group_wal_write_sync_durable_and_decodable(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = GroupCommitWAL(path, flush_interval=0.001)
+    for i in range(10):
+        wal.write_sync(WALMessage("consensus", b"m%d" % i))
+    wal.write_end_height(1)
+    wal.close()
+    with open(path, "rb") as f:
+        msgs = list(decode_records(f.read()))
+    assert [m.data for m in msgs[:10]] == [b"m%d" % i for i in range(10)]
+    assert msgs[10].kind == "end_height"
+    # every write_sync returned only after a covering fsync
+    assert wal.fsync_count >= 1
+
+
+def test_group_wal_coalesces_concurrent_fsyncs(tmp_path):
+    wal = GroupCommitWAL(
+        str(tmp_path / "wal"), flush_interval=0.05
+    )
+    n = 8
+    start = threading.Barrier(n)
+
+    def writer(i):
+        start.wait()
+        wal.write_sync(WALMessage("consensus", b"c%d" % i))
+
+    threads = [
+        threading.Thread(target=writer, args=(i,)) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    coalesced_fsyncs = wal.fsync_count
+    wal.close()
+    # 8 concurrent write_syncs share the flush thread's fsync(s):
+    # strictly fewer syncs than writers (the serial path pays one each)
+    assert 1 <= coalesced_fsyncs < n
+    with open(str(tmp_path / "wal"), "rb") as f:
+        assert len(list(decode_records(f.read()))) == n
+
+
+def test_group_wal_abarrier(tmp_path):
+    wal = GroupCommitWAL(str(tmp_path / "wal"), flush_interval=0.001)
+
+    async def run():
+        wal.write(WALMessage("consensus", b"x"))
+        await wal.abarrier()
+        # covered: a reopened reader sees the record
+        with open(str(tmp_path / "wal"), "rb") as f:
+            return list(decode_records(f.read()))
+
+    msgs = asyncio.run(run())
+    wal.close()
+    assert len(msgs) == 1 and msgs[0].data == b"x"
+
+
+def test_group_wal_search_end_height(tmp_path):
+    wal = GroupCommitWAL(str(tmp_path / "wal"), flush_interval=0.0)
+    wal.write_sync(WALMessage("consensus", b"h1"))
+    wal.write_end_height(1)
+    wal.write_sync(WALMessage("consensus", b"h2-partial"))
+    wal.barrier()
+    after = wal.search_for_end_height(1)
+    wal.close()
+    assert [m.data for m in after] == [b"h2-partial"]
+
+
+# --- write-behind block store ----------------------------------------------
+
+
+def _mini_chain(n):
+    """n tiny consecutive blocks + part sets + seen commits."""
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    out = []
+
+    async def run():
+        app = KVStoreApplication()
+        l2 = MockL2Node()
+        state_store = StateStore(MemKV())
+        state = State.from_genesis(genesis)
+        state_store.bootstrap(state)
+        bs = BlockStore(MemKV())
+        ex = BlockExecutor(state_store, bs, LocalClient(app), l2)
+        cs = ConsensusState(
+            ConsensusConfig.test_config(), state, ex, bs, l2,
+            priv_validator=pvs[0], wal=NilWAL(),
+        )
+        await cs.start()
+        await cs.wait_for_height(n, timeout=30)
+        await cs.stop()
+        for h in range(1, n + 1):
+            block = bs.load_block(h)
+            out.append(
+                (block, block.make_part_set(), bs.load_seen_commit(h))
+            )
+
+    asyncio.run(run())
+    return out
+
+
+def test_write_behind_store_overlay_and_durability():
+    chain = _mini_chain(3)
+    kv = MemKV()
+    store = WriteBehindBlockStore(kv, max_inflight=2)
+    for block, parts, seen in chain:
+        store.save_block(block, parts, seen)
+        h = block.header.height
+        # the logical view serves the pending save immediately
+        assert store.height == h
+        assert store.load_block(h).hash() == block.hash()
+        assert store.load_seen_commit(h) is not None
+        assert store.load_block_meta(h).block_id.hash == block.hash()
+    store.wait_durable()
+    assert store.durable_height == 3
+    assert store.save_queue_depth == 0
+    store.stop()
+    # a cold store over the same KV sees the full durable chain
+    reopened = BlockStore(kv)
+    assert reopened.height == 3
+    for block, _, _ in chain:
+        assert (
+            reopened.load_block(block.header.height).hash() == block.hash()
+        )
+
+
+def test_write_behind_store_rejects_gap():
+    chain = _mini_chain(2)
+    store = WriteBehindBlockStore(MemKV())
+    store.save_block(*chain[0])
+    with pytest.raises(ValueError):
+        store.save_block(*chain[0])  # height 1 again while at 1
+    store.stop()
+
+
+def test_write_behind_store_durable_range_trails_enqueue():
+    """The on-disk base/height record only ever covers fully-persisted
+    heights: a crash with saves queued replays like crash-before-save."""
+    chain = _mini_chain(2)
+    kv = FreezableKV()
+    store = WriteBehindBlockStore(kv, max_inflight=4)
+    store.save_block(*chain[0])
+    store.wait_durable()
+    kv.freeze()  # queue drains into dropped writes from here on
+    store.save_block(*chain[1])
+    store.wait_durable()
+    store.stop()
+    reopened = BlockStore(kv.inner)
+    assert reopened.height == 1  # height 2 never became durable
+    assert reopened.load_block(2) is None
+
+
+# --- pipelined finalize equivalence ----------------------------------------
+
+
+def _run_chain(tmp_path, name, pipelined, heights):
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+
+    async def run():
+        cs, app, l2, bs, ss, ex = _build_node(
+            genesis, pvs[0], str(tmp_path / name), pipelined=pipelined
+        )
+        await cs.start()
+        await cs.wait_for_height(heights, timeout=60)
+        await cs.stop()
+        bs.stop()
+        cs.wal.close()
+        return cs, app, bs
+
+    return asyncio.run(run())
+
+
+def test_pipelined_chain_matches_serial_app_hash(tmp_path):
+    """Same genesis, same deterministic L2 txs: the pipelined node must
+    land on the identical app hash and results as the serial path."""
+    heights = 4
+    cs_s, app_s, bs_s = _run_chain(tmp_path, "wal-serial", False, heights)
+    cs_p, app_p, bs_p = _run_chain(tmp_path, "wal-piped", True, heights)
+    assert cs_p.state.last_block_height >= heights
+    assert cs_p._applied_height >= heights
+    s, p = cs_s.state, cs_p.state
+    assert p.app_hash == s.app_hash
+    assert p.last_results_hash == s.last_results_hash
+    assert p.validators.hash() == s.validators.hash()
+    # the pipeline actually ran (not silently degraded to serial)
+    assert cs_p.pipeline.applied_heights >= heights
+    assert cs_p.pipeline.error is None
+    # blocks durable and identical content-wise (headers differ by time)
+    for h in range(1, heights + 1):
+        assert bs_p.load_block(h).data.txs == bs_s.load_block(h).data.txs
+
+
+def test_pipeline_wait_span_and_depth_gauge(tmp_path):
+    """The app-hash future is awaited through the instrumented barrier:
+    depth gauge returns to 0 and the wait histogram saw samples."""
+    from tendermint_tpu.libs.metrics import ConsensusMetrics, Registry
+    from tendermint_tpu import obs
+
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    metrics = ConsensusMetrics(Registry("pipetest"))
+    tracer = obs.Tracer(enabled=True, ring_size=4096)
+
+    async def run():
+        cs, app, l2, bs, ss, ex = _build_node(
+            genesis, pvs[0], str(tmp_path / "wal"), pipelined=True,
+            tracer=tracer, metrics=metrics,
+        )
+        cs.metrics = metrics
+        cs.tracer = tracer
+        await cs.start()
+        await cs.wait_for_height(3, timeout=60)
+        await cs.stop()
+        bs.stop()
+        cs.wal.close()
+
+    asyncio.run(run())
+    assert metrics.commit_pipeline_depth.value() == 0
+    names = {r.name for r in tracer.records()}
+    assert "wal.group_fsync" in names
+    assert "store.save_block_async" in names
+
+
+def test_pipeline_wait_records_span_and_histogram():
+    """wait_applied under a genuinely in-flight apply: the barrier
+    records the commit.pipeline_wait span + histogram sample, and the
+    depth gauge tracks the in-flight task."""
+    from tendermint_tpu.libs.metrics import ConsensusMetrics, Registry
+    from tendermint_tpu import obs
+
+    metrics = ConsensusMetrics(Registry("pipewait"))
+    tracer = obs.Tracer(enabled=True, ring_size=128)
+    pipe = CommitPipeline(metrics=metrics, tracer=tracer)
+
+    async def run():
+        gate = asyncio.Event()
+
+        async def slow_apply():
+            assert metrics.commit_pipeline_depth.value() == 1
+            await gate.wait()
+            return "applied-state"
+
+        pipe.begin(7, slow_apply)
+        assert pipe.inflight_height == 7
+        asyncio.get_running_loop().call_later(0.02, gate.set)
+        out = await pipe.wait_applied()
+        assert out == "applied-state"
+        # resolved barrier: second wait is a no-op returning None
+        assert await pipe.wait_applied() is None
+
+    asyncio.run(run())
+    assert metrics.commit_pipeline_depth.value() == 0
+    spans = [r for r in tracer.records() if r.name == "commit.pipeline_wait"]
+    assert len(spans) == 1
+    hist = metrics.commit_pipeline_wait_seconds
+    assert sum(s.total for s in hist._series.values()) == 1
+
+
+def test_pipeline_failed_apply_wedges():
+    """A failed background finalization latches: every later barrier
+    raises instead of silently running on a half-applied state."""
+    pipe = CommitPipeline()
+
+    async def run():
+        async def bad_apply():
+            raise RuntimeError("apply exploded")
+
+        task = pipe.begin(3, bad_apply)
+        with pytest.raises(RuntimeError):
+            await pipe.wait_applied()
+        assert pipe.error is not None
+        with pytest.raises(RuntimeError):
+            await pipe.wait_applied()
+        await pipe.drain()
+
+    asyncio.run(run())
+
+
+# --- crash-recovery at each pipeline stage boundary (chaos) -----------------
+
+
+def _crash_and_recover(tmp_path, freeze_block_kv, freeze_state_kv,
+                       batches_only=False, reuse_app=False):
+    """Run a pipelined node to height 2 durably, freeze the chosen KVs
+    (writes after this are 'lost'), run one more height, crash (abandon
+    without clean stop), then restart from the durable image + real WAL
+    and converge to height 4. Returns (restarted cs, app)."""
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    block_kv = FreezableKV()
+    state_kv = FreezableKV(freeze_batches_only=batches_only)
+    app = KVStoreApplication()
+    wal_path = str(tmp_path / "wal")
+
+    async def first_run():
+        cs, _, l2, bs, ss, ex = _build_node(
+            genesis, pvs[0], wal_path, pipelined=True,
+            app=app, block_kv=block_kv, state_kv=state_kv,
+        )
+        await _handshake(cs, genesis, ex, ss, bs)
+        await cs.start()
+        await cs.wait_for_height(2, timeout=60)
+        bs.wait_durable()
+        if freeze_block_kv:
+            block_kv.freeze()
+        if freeze_state_kv:
+            state_kv.freeze()
+        await cs.wait_for_height(3, timeout=60)
+        # crash: stop the loops but leave stores/WAL exactly as-is
+        # (the frozen KVs already dropped the 'in-flight' writes)
+        await cs.stop()
+        bs.stop()
+        cs.wal.close()
+
+    asyncio.run(first_run())
+
+    async def second_run():
+        cs, app2, l2, bs, ss, ex = _build_node(
+            genesis, pvs[0], wal_path, pipelined=True,
+            app=app if reuse_app else None,
+            block_kv=block_kv.inner, state_kv=state_kv.inner,
+        )
+        await _handshake(cs, genesis, ex, ss, bs)
+        await cs.start()
+        await cs.wait_for_height(4, timeout=60)
+        await cs.stop()
+        bs.stop()
+        cs.wal.close()
+        return cs, app2
+
+    return asyncio.run(second_run())
+
+
+def _serial_reference(tmp_path, heights=4):
+    cs, app, bs = _run_chain(tmp_path, "wal-ref", False, heights)
+    return cs.state
+
+
+@pytest.mark.chaos
+def test_crash_after_wal_end_height_block_save_lost(tmp_path):
+    """Stage boundary 1: WAL end-height durable, block save + apply
+    lost. Replay must re-drive the height to the serial outcome."""
+    cs, app = _crash_and_recover(
+        tmp_path, freeze_block_kv=True, freeze_state_kv=True
+    )
+    ref = _serial_reference(tmp_path)
+    assert cs.state.last_block_height >= 4
+    assert cs.state.app_hash == ref.app_hash
+    assert cs.state.last_results_hash == ref.last_results_hash
+
+
+@pytest.mark.chaos
+def test_crash_after_block_save_apply_lost(tmp_path):
+    """Stage boundary 2: WAL end-height + block durable, apply/state
+    save lost. Handshake applies the final stored block."""
+    cs, app = _crash_and_recover(
+        tmp_path, freeze_block_kv=False, freeze_state_kv=True
+    )
+    ref = _serial_reference(tmp_path)
+    assert cs.state.last_block_height >= 4
+    assert cs.state.app_hash == ref.app_hash
+    assert cs.state.last_results_hash == ref.last_results_hash
+
+
+@pytest.mark.chaos
+def test_crash_mid_apply_app_committed_state_lost(tmp_path):
+    """Stage boundary 3 (mid-apply): the app committed the block but
+    the state record was lost. The handshake must rebuild state from
+    the saved ABCI responses WITHOUT double-executing the block (the
+    surviving app's hash must match the serial chain's)."""
+    cs, app = _crash_and_recover(
+        tmp_path,
+        freeze_block_kv=False,
+        freeze_state_kv=True,
+        batches_only=True,  # responses (set) land, state batch lost
+        reuse_app=True,  # the app process survived the crash
+    )
+    ref = _serial_reference(tmp_path)
+    assert cs.state.last_block_height >= 4
+    assert cs.state.app_hash == ref.app_hash
+    assert cs.state.last_results_hash == ref.last_results_hash
+
+
+@pytest.mark.chaos
+def test_pipelined_restart_clean(tmp_path):
+    """No crash window at all: clean stop + restart through handshake
+    and WAL catchup, pipelined both times."""
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    block_kv, state_kv = MemKV(), MemKV()
+    app = KVStoreApplication()
+    wal_path = str(tmp_path / "wal")
+
+    async def run_to(height):
+        cs, _, l2, bs, ss, ex = _build_node(
+            genesis, pvs[0], wal_path, pipelined=True,
+            app=app, block_kv=block_kv, state_kv=state_kv,
+        )
+        await _handshake(cs, genesis, ex, ss, bs)
+        await cs.start()
+        await cs.wait_for_height(height, timeout=60)
+        await cs.stop()
+        bs.stop()
+        cs.wal.close()
+        return cs
+
+    cs1 = asyncio.run(run_to(2))
+    assert cs1.state.last_block_height >= 2
+    cs2 = asyncio.run(run_to(4))
+    assert cs2.state.last_block_height >= 4
+    assert cs2.pipeline.error is None
+
+
+def test_group_wal_fsync_failure_latches_not_fake_durable(tmp_path):
+    """A failing fsync must RAISE at the barrier (and on later writes),
+    never report the records durable (double-sign risk on replay)."""
+    wal = GroupCommitWAL(str(tmp_path / "wal"), flush_interval=0.0)
+
+    def boom():
+        raise OSError("disk on fire")
+
+    wal._group.sync = boom
+    with pytest.raises(RuntimeError):
+        wal.write_sync(WALMessage("consensus", b"x"))
+    with pytest.raises(RuntimeError):
+        wal.write(WALMessage("consensus", b"y"))
+
+    async def arun():
+        with pytest.raises(RuntimeError):
+            # uncovered records + latched error -> raise, not hang
+            await wal.abarrier()
+
+    asyncio.run(arun())
+    wal._closed = True  # skip the drain (sync is broken)
+    wal._flusher.join(timeout=2)
+
+
+def test_write_behind_store_never_persists_past_a_failed_save():
+    """A failed save latches AND stops persistence: later queued heights
+    must not advance the durable range over the hole (handshake replay
+    would hit 'missing block' forever)."""
+    chain = _mini_chain(3)
+    kv = MemKV()
+    store = WriteBehindBlockStore(kv, max_inflight=4)
+    store.save_block(*chain[0])
+    store.wait_durable()
+    real_batch = kv.write_batch
+    calls = {"n": 0}
+
+    def flaky(sets, deletes):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient kv failure")
+        real_batch(sets, deletes)
+
+    kv.write_batch = flaky
+    store.save_block(*chain[1])  # fails in the worker, latches
+    store.save_block(*chain[2])  # must be DISCARDED, not persisted
+    with pytest.raises(RuntimeError):
+        store.wait_durable()
+    with pytest.raises(RuntimeError):
+        store.save_block(*chain[2])  # latched error rejects new saves
+    store.stop()
+    reopened = BlockStore(kv)
+    assert reopened.height == 1  # range never advanced over the hole
+    assert reopened.load_block(2) is None
+    assert reopened.load_block(3) is None
+
+
+def test_responses_roundtrip_validator_updates(tmp_path):
+    """The saved-responses crash-recovery path must rebuild the same
+    next validator set: val/param updates ride the blob."""
+    from tendermint_tpu.state.execution import ABCIResponses
+
+    r = ABCIResponses()
+    r.val_updates = [("ed25519", b"\x01" * 32, 7)]
+    r.param_updates = {"block": {"max_bytes": 123}}
+    back = ABCIResponses.decode(r.encode())
+    assert back.val_updates == [("ed25519", b"\x01" * 32, 7)]
+    assert back.param_updates == {"block": {"max_bytes": 123}}
+    assert back.end_block.consensus_param_updates == r.param_updates
+
+
+@pytest.mark.chaos
+def test_crash_mid_apply_with_validator_update(tmp_path):
+    """Finding-3 regression: crash in the 'app committed, state lost'
+    window at a height that carries an L2 validator update — recovery
+    must apply the update (validators present at the right height)."""
+    from tendermint_tpu.crypto import ed25519 as hosted
+
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    block_kv = FreezableKV()
+    state_kv = FreezableKV(freeze_batches_only=True)
+    app = KVStoreApplication()
+    wal_path = str(tmp_path / "wal")
+    new_key = hosted.PrivKey.from_secret(b"joiner").public_key()
+    l2 = MockL2Node()
+    # the L2 injects a validator update at height 3 (the crash height)
+    l2.validator_updates[3] = [("ed25519", new_key.data, 5)]
+
+    async def first_run():
+        cs, _, _, bs, ss, ex = _build_node(
+            genesis, pvs[0], wal_path, pipelined=True,
+            app=app, l2=l2, block_kv=block_kv, state_kv=state_kv,
+        )
+        await _handshake(cs, genesis, ex, ss, bs)
+        await cs.start()
+        await cs.wait_for_height(2, timeout=60)
+        bs.wait_durable()
+        state_kv.freeze()  # state batches lost from here (responses land)
+        await cs.wait_for_height(3, timeout=60)
+        await cs.stop()
+        bs.stop()
+        cs.wal.close()
+
+    asyncio.run(first_run())
+
+    async def second_run():
+        cs, _, _, bs, ss, ex = _build_node(
+            genesis, pvs[0], wal_path, pipelined=True,
+            app=app, l2=l2,
+            block_kv=block_kv.inner, state_kv=state_kv.inner,
+        )
+        await _handshake(cs, genesis, ex, ss, bs)
+        return cs
+
+    cs = asyncio.run(second_run())
+    assert cs.state.last_block_height >= 3
+    # the update at height 3 lands in next_validators (effective H+2)
+    addrs = {v.address for v in cs.state.next_validators.validators}
+    assert new_key.address() in addrs
+
+
+def test_wal_write_failure_drops_batch_keeps_routine(tmp_path):
+    """Receive-routine isolation: a WAL failure mid-run must not kill
+    consensus — un-logged internal messages are dropped, the loop
+    survives, and (after the WAL heals) the chain keeps committing."""
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+
+    async def run():
+        cs, app, l2, bs, ss, ex = _build_node(
+            genesis, pvs[0], str(tmp_path / "wal"), pipelined=True
+        )
+        await cs.start()
+        await cs.wait_for_height(1, timeout=60)
+        # poison ONE barrier round, then heal
+        real = cs.wal.abarrier
+        state = {"n": 0}
+
+        async def flaky():
+            if state["n"] == 0:
+                state["n"] += 1
+                raise RuntimeError("transient barrier failure")
+            await real()
+
+        cs.wal.abarrier = flaky
+        await cs.wait_for_height(3, timeout=60)
+        assert cs._receive_task is not None and not cs._receive_task.done()
+        await cs.stop()
+        bs.stop()
+        cs.wal.close()
+        return state["n"]
+
+    assert asyncio.run(run()) == 1
+
+
+def test_prune_waits_for_saves_below_boundary():
+    """Pruning must not delete heights whose write-behind save is still
+    queued — the late save would resurrect pruned blocks and corrupt
+    the on-disk range record."""
+    chain = _mini_chain(3)
+    kv = MemKV()
+    gate = threading.Event()
+    real_batch = kv.write_batch
+    stalled = {"first": True}
+
+    def gated(sets, deletes):
+        if stalled["first"]:
+            stalled["first"] = False
+            gate.wait(5)  # stall height 1's save until released
+        real_batch(sets, deletes)
+
+    kv.write_batch = gated
+    store = WriteBehindBlockStore(kv, max_inflight=4)
+    for entry in chain:
+        store.save_block(*entry)
+    done = {"pruned": None}
+
+    def prune():
+        done["pruned"] = store.prune_blocks(3)  # retain 3: delete 1, 2
+
+    t = threading.Thread(target=prune)
+    t.start()
+    time.sleep(0.1)
+    assert t.is_alive()  # blocked: heights 1-2 not durable yet
+    gate.set()
+    t.join(10)
+    assert done["pruned"] == 2
+    store.wait_durable()
+    store.stop()
+    reopened = BlockStore(kv)
+    assert reopened.base == 3 and reopened.height == 3
+    assert reopened.load_block(3) is not None
+    assert reopened.load_block(1) is None and reopened.load_block(2) is None
+
+
+def test_apply_waits_block_durability_before_state_save():
+    """Durable state must never outrun the durable block: apply_block
+    barriers on the write-behind store before persisting state."""
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    events = []
+
+    class SpyBlockStore(BlockStore):
+        def wait_durable(self, height=None, timeout=None):
+            events.append(("wait_durable", height))
+
+    class SpyStateStore(StateStore):
+        def save(self, state):
+            events.append(("state_save", state.last_block_height))
+            super().save(state)
+
+    async def run():
+        app = KVStoreApplication()
+        l2 = MockL2Node()
+        state_store = SpyStateStore(MemKV())
+        state = State.from_genesis(genesis)
+        state_store.bootstrap(state)
+        bs = SpyBlockStore(MemKV())
+        ex = BlockExecutor(state_store, bs, LocalClient(app), l2)
+        cs = ConsensusState(
+            ConsensusConfig.test_config(), state, ex, bs, l2,
+            priv_validator=pvs[0], wal=NilWAL(),
+        )
+        await cs.start()
+        await cs.wait_for_height(1, timeout=30)
+        await cs.stop()
+
+    asyncio.run(run())
+    # the block-durability barrier for height 1 precedes its state save
+    assert ("wait_durable", 1) in events
+    assert events.index(("wait_durable", 1)) < events.index(
+        ("state_save", 1)
+    )

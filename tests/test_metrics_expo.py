@@ -197,3 +197,26 @@ def test_metrics_server_paths_and_verbs():
     )
     assert clen == len(reg.render().encode())
     assert post.startswith(b"HTTP/1.1 405")
+
+
+def test_gauge_dec_and_track_inprogress():
+    from tendermint_tpu.libs.metrics import Registry
+
+    reg = Registry("tig")
+    g = reg.gauge("inflight", "work in flight", ("klass",))
+    g.inc(3, klass="a")
+    g.dec(klass="a")
+    assert g.value(klass="a") == 2
+    with g.track_inprogress(5, klass="b"):
+        assert g.value(klass="b") == 5
+        with g.track_inprogress(klass="b"):
+            assert g.value(klass="b") == 6
+    assert g.value(klass="b") == 0
+    # the context restores on exceptions too (the try/finally it replaces)
+    try:
+        with g.track_inprogress(klass="a"):
+            assert g.value(klass="a") == 3
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert g.value(klass="a") == 2

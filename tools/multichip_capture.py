@@ -58,9 +58,66 @@ def capture(n_devices: int) -> dict:
         }
 
 
+def _cpu_fallback(n: int, first: dict) -> dict | None:
+    """Infrastructure outage (tunnel_down/timeout): retry the capture
+    once in a child whose environment has the tunnel plugin site fully
+    scrubbed and JAX_PLATFORMS pinned to cpu — same fallback contract
+    as bench.py's `_degrade`. Returns the merged artifact or None."""
+    import subprocess
+
+    from tendermint_tpu.chaos.backend_guard import sanitized_env
+
+    env = sanitized_env(platform="cpu")
+    env["TM_TPU_MULTICHIP_CHILD"] = "1"
+    timeout_s = float(
+        os.environ.get("TM_TPU_MULTICHIP_FALLBACK_TIMEOUT", "1800")
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), str(n)],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    parsed = None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if proc.returncode == 0 and isinstance(parsed, dict) and parsed.get("ok"):
+        # rc=0: the outage lives in the artifact, the capture itself is
+        # good data from the sanitized CPU mesh
+        parsed.update(
+            {
+                "rc": 0,
+                "fallback": "cpu",
+                "error": first.get("error", ""),
+                "kind": first.get("kind", ""),
+            }
+        )
+        return parsed
+    return None
+
+
 def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    print(json.dumps(capture(n)))
+    art = capture(n)
+    if (
+        not art["ok"]
+        and art.get("kind") in ("tunnel_down", "timeout")
+        and os.environ.get("TM_TPU_MULTICHIP_CHILD") != "1"
+    ):
+        merged = _cpu_fallback(n, art)
+        if merged is not None:
+            print(json.dumps(merged))
+            return
+    print(json.dumps(art))
 
 
 if __name__ == "__main__":
