@@ -338,6 +338,9 @@ def main() -> None:
         "microbenchmarks + real-silicon projections in PERF_ANALYSIS.md",
         file=sys.stderr,
     )
+    # run the in-proc net once; the attribution ships as the breakdown
+    # and the quorum-close lags join the bench family as scalars
+    height_attribution = _bench_height_attribution()
     print(
         json.dumps(
             {
@@ -372,14 +375,36 @@ def main() -> None:
                 + _extra_metrics(
                     cached_fn, tables, valid, idx, rb, sb, kb, s_ok
                 )
-                + _bench_commit_path(),
+                + _bench_commit_path()
+                + _quorum_lag_metrics(height_attribution),
                 # where a height's wall time goes (p50/p95 per consensus
                 # step + WAL/store/verify spans) — the scalar above finally
                 # ships with its breakdown
-                "latency_attribution": _bench_height_attribution(),
+                "latency_attribution": height_attribution,
             }
         )
     )
+
+
+def _quorum_lag_metrics(att) -> list:
+    """Quorum-close lag scalars for the bench family: first precommit of
+    the round to the vote that closed 2/3 (the committee-spread slice of
+    height latency the cluster tracer attributes per validator)."""
+    q = (att or {}).get("quorum_close") or {}
+    if not q.get("count"):
+        return []
+    return [
+        {
+            "metric": "quorum_close_lag_p50",
+            "value": q["p50_ms"],
+            "unit": "ms",
+        },
+        {
+            "metric": "quorum_close_lag_p95",
+            "value": q["p95_ms"],
+            "unit": "ms",
+        },
+    ]
 
 
 def _bench_commit_path() -> list:
@@ -583,9 +608,25 @@ def _bench_height_attribution():
 
         try:
             asyncio.run(run())
-            return obs.attribution(
-                [r.to_json() for r in tracer.records()]
-            )
+            recs = [r.to_json() for r in tracer.records()]
+            att = obs.attribution(recs)
+            # per-height quorum-close lag (height_vote_set.py events):
+            # the committee-spread baseline BENCH artifacts track
+            from tendermint_tpu.obs.report import pct
+
+            lags = [
+                float((r.get("fields") or {}).get("lag_ms", 0.0))
+                for r in recs
+                if r.get("name") == "quorum.close"
+                and (r.get("fields") or {}).get("type") == "precommit"
+            ]
+            if lags:
+                att["quorum_close"] = {
+                    "count": len(lags),
+                    "p50_ms": round(pct(lags, 0.5), 3),
+                    "p95_ms": round(pct(lags, 0.95), 3),
+                }
+            return att
         finally:
             tracer.enabled = was_enabled
     except Exception as e:

@@ -85,6 +85,10 @@ class P2PConfig:
     # RecvRate, default 5120000); 0 disables throttling
     send_rate: int = 5120000
     recv_rate: int = 5120000
+    # keepalive cadence (reference PingInterval); also the sampling rate
+    # of the per-peer NTP clock-offset estimate cluster tracing rebases
+    # merged timelines with (p2p/mconn.py)
+    ping_interval: float = 10.0
     # NAT traversal: map the listen port on the UPnP gateway at start
     # (reference config UPNP, default false)
     upnp: bool = False
@@ -96,6 +100,8 @@ class P2PConfig:
             raise ValueError("p2p.max_num_outbound_peers cannot be negative")
         if self.send_rate < 0 or self.recv_rate < 0:
             raise ValueError("p2p rate caps cannot be negative")
+        if self.ping_interval <= 0:
+            raise ValueError("p2p.ping_interval must be > 0")
 
     def peer_list(self, s: str) -> list[str]:
         return [p.strip() for p in s.split(",") if p.strip()]

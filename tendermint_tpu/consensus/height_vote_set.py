@@ -3,27 +3,48 @@
 Reference: consensus/types/height_vote_set.go: lazily creates round vote
 sets; tracks which rounds a peer has claimed catch-up majorities for
 (SetPeerMaj23); surfaces equivocation as ErrVoteConflictingVotes.
+
+This is also the quorum-latency attribution seam (obs/cluster.py): every
+ACCEPTED vote records its arrival lag behind the round's first vote of
+the same type, and the vote that flips a VoteSet to 2/3 records a
+`quorum.close` event naming the closing validator — the single number
+that says which straggler the committee was waiting on.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
+from ..libs.metrics import bounded_label
+from ..obs import default_tracer
 from ..types.validator_set import ValidatorSet
-from ..types.vote import Vote, VoteType
+from ..types.vote import VOTE_TYPE_NAMES, Vote, VoteType
 from ..types.vote_set import ConflictingVoteError, VoteSet
 
 
 class HeightVoteSet:
     MAX_CATCHUP_ROUNDS = 2  # peer-triggered rounds beyond current
 
-    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        val_set: ValidatorSet,
+        tracer=None,
+        metrics=None,
+    ):
         self.chain_id = chain_id
         self.height = height
         self.val_set = val_set
         self.round = 0
+        self.tracer = default_tracer() if tracer is None else tracer
+        self.metrics = metrics
         self._rounds: dict[int, dict[int, VoteSet]] = {}
         self._peer_catchup_rounds: dict[str, list[int]] = {}
+        # (round, type) -> perf_counter of the first accepted vote; lag
+        # attribution is relative to this
+        self._first_arrival: dict[tuple[int, int], float] = {}
         self.set_round(0)
 
     def set_round(self, round_: int) -> None:
@@ -72,9 +93,62 @@ class HeightVoteSet:
                     )
                 rounds.append(vote.round)
         self._ensure_round(vote.round)
-        return self._rounds[vote.round][vote.type].add_vote(
-            vote, verified=verified
-        )
+        vs = self._rounds[vote.round][vote.type]
+        had_quorum = vs.has_two_thirds_majority()
+        added = vs.add_vote(vote, verified=verified)
+        if added:
+            self._attribute_arrival(vote, vs, had_quorum, peer_id)
+        return added
+
+    # --- quorum-latency attribution --------------------------------------
+
+    def _attribute_arrival(
+        self, vote: Vote, vs: VoteSet, had_quorum: bool, peer_id: str
+    ) -> None:
+        """Record arrival lag for an accepted vote and, when it flipped
+        the set to 2/3, the quorum-close attribution."""
+        tracer = self.tracer
+        metrics = self.metrics
+        if metrics is None and not tracer.enabled:
+            return
+        now = time.perf_counter()
+        key = (vote.round, vote.type)
+        first = self._first_arrival.setdefault(key, now)
+        lag = now - first
+        tname = VOTE_TYPE_NAMES.get(vote.type, str(vote.type))
+        if metrics is not None:
+            metrics.vote_arrival_lag.observe(lag, type=tname)
+        if tracer.enabled:
+            tracer.event(
+                "quorum.vote",
+                height=vote.height,
+                round=vote.round,
+                type=tname,
+                val=vote.validator_index,
+                peer=peer_id,
+                lag_ms=round(lag * 1e3, 3),
+            )
+        if had_quorum or not vs.has_two_thirds_majority():
+            return
+        # this vote closed the 2/3 quorum
+        if metrics is not None:
+            metrics.quorum_close_lag.observe(lag, type=tname)
+            metrics.quorum_closer.inc(
+                validator=bounded_label(
+                    "quorum_closer", str(vote.validator_index), 64
+                ),
+                type=tname,
+            )
+        if tracer.enabled:
+            tracer.event(
+                "quorum.close",
+                height=vote.height,
+                round=vote.round,
+                type=tname,
+                closer=vote.validator_index,
+                peer=peer_id,
+                lag_ms=round(lag * 1e3, 3),
+            )
 
     def set_peer_maj23(
         self, round_: int, vote_type: int, peer_id: str, block_id

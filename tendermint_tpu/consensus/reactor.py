@@ -20,11 +20,12 @@ from typing import Optional
 
 from ..libs.bits import BitArray
 from ..libs.log import Logger, nop_logger
+from ..libs.metrics import bounded_label
 from ..p2p.mconn import ChannelDescriptor
 from ..p2p.switch import Reactor
 from ..p2p.transport import Peer
 from ..types.part_set import PartSet
-from ..types.vote import Vote, VoteType
+from ..types.vote import VOTE_TYPE_NAMES, Vote, VoteType
 from .messages import (
     BlockPartMessage,
     HasVoteMessage,
@@ -133,6 +134,11 @@ class ConsensusReactor(Reactor):
             vote_batcher = VoteBatcher(verifier=cs.verifier)
         self.vote_batcher = vote_batcher
         self.logger = logger or nop_logger()
+        # causal gossip annotations (obs/cluster.py): every proposal/
+        # block-part/vote send+receive is an event tagged with enough
+        # identity (height, round, type, index, peer) that a receive on
+        # node B joins the matching send on node A in a merged timeline
+        self.tracer = cs.tracer
         # aggregate micro-batcher for batch-point BLS signatures: a
         # round's burst verifies as 2 pairings instead of 2 per vote
         from .bls_batcher import BLSBatcher
@@ -189,9 +195,54 @@ class ConsensusReactor(Reactor):
         if self.switch is None:
             return
         if isinstance(msg, (ProposalMessage, BlockPartMessage)):
+            if self.tracer.enabled:
+                if isinstance(msg, ProposalMessage):
+                    self._gossip_event(
+                        "send",
+                        "*",
+                        msg.proposal.height,
+                        msg.proposal.round,
+                        type="proposal",
+                    )
+                else:
+                    self._gossip_event(
+                        "send",
+                        "*",
+                        msg.height,
+                        msg.round,
+                        type="block_part",
+                        part=msg.part.index,
+                    )
             self.switch.broadcast(DATA_CHANNEL, encode_msg(msg))
         elif isinstance(msg, VoteMessage):
+            if self.tracer.enabled:
+                self._vote_gossip_event("send", "*", msg.vote)
             self.switch.broadcast(VOTE_CHANNEL, encode_msg(msg))
+
+    # --- causal gossip annotations ---------------------------------------
+
+    def _gossip_event(
+        self, direction: str, peer_id: str, height: int, round_: int, **fields
+    ) -> None:
+        """peer_id is the remote end: destination for sends ("*" = every
+        connected peer via switch.broadcast), source for receives."""
+        self.tracer.event(
+            f"gossip.{direction}",
+            height=height,
+            round=round_,
+            peer=peer_id,
+            **fields,
+        )
+
+    def _vote_gossip_event(self, direction: str, peer_id: str, vote) -> None:
+        self._gossip_event(
+            direction,
+            peer_id,
+            vote.height,
+            vote.round,
+            type=VOTE_TYPE_NAMES.get(vote.type, str(vote.type)),
+            val=vote.validator_index,
+        )
 
     def _new_round_step_msg(self) -> NewRoundStepMessage:
         rs = self.cs.rs
@@ -291,6 +342,25 @@ class ConsensusReactor(Reactor):
                         msg.proposal.block_id.part_set_header.total
                     )
                 prs.proposal_pol_round = msg.proposal.pol_round
+                if self.tracer.enabled:
+                    self._gossip_event(
+                        "recv",
+                        peer.id,
+                        msg.proposal.height,
+                        msg.proposal.round,
+                        type="proposal",
+                    )
+                if cs.metrics is not None:
+                    # proposer timestamp to our receipt; biased by the
+                    # proposer-peer clock offset, which the per-peer
+                    # offset gauge makes explicit
+                    cs.metrics.proposal_gossip_seconds.observe(
+                        max(
+                            0.0,
+                            (cs.now_ns() - msg.proposal.timestamp_ns) / 1e9,
+                        ),
+                        peer=bounded_label("consensus_gossip_peer", peer.id),
+                    )
                 await cs.add_proposal(msg.proposal, peer.id)
             elif isinstance(msg, ProposalPOLMessage):
                 if msg.height == prs.height:
@@ -299,9 +369,20 @@ class ConsensusReactor(Reactor):
             elif isinstance(msg, BlockPartMessage):
                 if prs.proposal_block_parts is not None:
                     prs.proposal_block_parts.set(msg.part.index, True)
+                if self.tracer.enabled:
+                    self._gossip_event(
+                        "recv",
+                        peer.id,
+                        msg.height,
+                        msg.round,
+                        type="block_part",
+                        part=msg.part.index,
+                    )
                 await cs.add_block_part(msg.height, msg.round, msg.part, peer.id)
         elif channel_id == VOTE_CHANNEL:
             if isinstance(msg, VoteMessage):
+                if self.tracer.enabled:
+                    self._vote_gossip_event("recv", peer.id, msg.vote)
                 size = cs.state.validators.size()
                 prs.set_has_vote(
                     msg.vote.height,
@@ -420,6 +501,15 @@ class ConsensusReactor(Reactor):
                                 BlockPartMessage(rs.height, rs.round, part)
                             ),
                         ):
+                            if self.tracer.enabled:
+                                self._gossip_event(
+                                    "send",
+                                    peer.id,
+                                    rs.height,
+                                    rs.round,
+                                    type="block_part",
+                                    part=idx,
+                                )
                             prs.proposal_block_parts.set(idx, True)
                             continue
                 # 2. peer is on an older height: catch them up from the store
@@ -439,6 +529,14 @@ class ConsensusReactor(Reactor):
                     if peer.send(
                         DATA_CHANNEL, encode_msg(ProposalMessage(rs.proposal))
                     ):
+                        if self.tracer.enabled:
+                            self._gossip_event(
+                                "send",
+                                peer.id,
+                                rs.height,
+                                rs.round,
+                                type="proposal",
+                            )
                         prs.proposal = True
                         if 0 <= rs.proposal.pol_round:
                             pv = rs.votes.prevotes(rs.proposal.pol_round)
@@ -490,7 +588,22 @@ class ConsensusReactor(Reactor):
             DATA_CHANNEL,
             encode_msg(BlockPartMessage(prs.height, prs.round, part)),
         ):
+            if self.tracer.enabled:
+                self._gossip_event(
+                    "send",
+                    peer.id,
+                    prs.height,
+                    prs.round,
+                    type="block_part",
+                    part=idx,
+                )
             prs.proposal_block_parts.set(idx, True)
+        else:
+            # failed send (full queue / stopping mconn): MUST yield — the
+            # caller `continue`s straight back here, and a no-await spin
+            # starves the loop and can never even be cancelled (seen as a
+            # teardown hang with a catching-up peer)
+            await asyncio.sleep(GOSSIP_SLEEP)
 
     async def _gossip_votes_routine(self, peer: Peer, prs: PeerRoundState) -> None:
         """reference gossipVotesRoutine :671: send one vote the peer lacks."""
@@ -543,6 +656,8 @@ class ConsensusReactor(Reactor):
         if vote is None:
             return False
         if peer.send(VOTE_CHANNEL, encode_msg(VoteMessage(vote))):
+            if self.tracer.enabled:
+                self._vote_gossip_event("send", peer.id, vote)
             theirs.set(idx, True)
             return True
         return False
@@ -572,6 +687,8 @@ class ConsensusReactor(Reactor):
                 bls_signature=csig.bls_signature,
             )
             if peer.send(VOTE_CHANNEL, encode_msg(VoteMessage(vote))):
+                if self.tracer.enabled:
+                    self._vote_gossip_event("send", peer.id, vote)
                 theirs.set(i, True)
                 return True
         return False

@@ -1205,7 +1205,13 @@ class ConsensusState:
         rs.valid_round = -1
         rs.valid_block = None
         rs.valid_block_parts = None
-        rs.votes = HeightVoteSet(state.chain_id, height, state.validators)
+        rs.votes = HeightVoteSet(
+            state.chain_id,
+            height,
+            state.validators,
+            tracer=self.tracer,
+            metrics=self.metrics,
+        )
         rs.commit_round = -1
         rs.last_commit = last_precommits
         rs.triggered_timeout_precommit = False

@@ -12,6 +12,14 @@ Histograms support labels (one bucket series per label-value tuple) so
 one per step. Registering the same name under a different metric kind
 raises TypeError — a silent kind collision returns an object whose API
 doesn't match what the second caller asked for.
+
+Label cardinality is bounded: every labeled family caps its distinct
+label-value tuples at `max_series` (default MAX_LABEL_SERIES) and raises
+`MetricCardinalityError` past the cap — an unbounded label (peer id,
+validator address) would otherwise grow the exposition without limit.
+Callers that genuinely label by peer/validator go through
+`bounded_label()`, a per-family top-K admission filter that maps the
+long tail to "_other" so the cap is never hit in practice.
 """
 
 from __future__ import annotations
@@ -22,6 +30,50 @@ import time
 from typing import Optional
 
 from .service import Service
+
+# default cap on distinct label-value tuples per metric family; far
+# above every legitimate family (chID/step/method are all < 32) and far
+# below where a leaked unbounded label would hurt the exposition
+MAX_LABEL_SERIES = 512
+
+
+class MetricCardinalityError(RuntimeError):
+    """A labeled metric family exceeded its max_series cap."""
+
+    def __init__(self, name: str, cap: int, key: tuple):
+        super().__init__(
+            f"metric family {name!r} exceeded its label-cardinality cap "
+            f"({cap} series) adding {key!r}; bound the label with "
+            f"bounded_label() or raise max_series explicitly"
+        )
+
+
+# --- top-K label admission (bounded_label) ---------------------------------
+
+_label_sets: dict[str, set] = {}
+_label_sets_lock = threading.Lock()
+
+# overflow bucket for values past the per-family top-K
+OTHER_LABEL = "_other"
+
+
+def bounded_label(family: str, value: str, k: int = 32) -> str:
+    """Admit the first `k` distinct values of `family` verbatim; map
+    everything after to OTHER_LABEL. First-come-first-kept: in a stable
+    deployment the long-lived peers/validators claim the slots, and churn
+    lands in the overflow bucket instead of new series. Counters and
+    histograms may aggregate into OTHER_LABEL (additive semantics);
+    GAUGE callers should skip recording when they get OTHER_LABEL back —
+    a last-write-wins series shared by unrelated values flaps."""
+    value = str(value)
+    with _label_sets_lock:
+        seen = _label_sets.setdefault(family, set())
+        if value in seen:
+            return value
+        if len(seen) < k:
+            seen.add(value)
+            return value
+    return OTHER_LABEL
 
 
 def _escape_label(v) -> str:
@@ -47,16 +99,33 @@ def _fmt_labels(names, values, extra: str = "") -> str:
 
 
 class Counter:
-    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        labels: tuple[str, ...] = (),
+        max_series: int = MAX_LABEL_SERIES,
+    ):
         self.name = name
         self.help = help_
         self.label_names = tuple(labels)
+        self.max_series = max_series
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
+
+    def _admit(self, key: tuple) -> None:
+        """Under self._lock: refuse a NEW label tuple past the cap."""
+        if (
+            self.label_names
+            and key not in self._values
+            and len(self._values) >= self.max_series
+        ):
+            raise MetricCardinalityError(self.name, self.max_series, key)
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         key = tuple(labels.get(k, "") for k in self.label_names)
         with self._lock:
+            self._admit(key)
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
@@ -99,6 +168,7 @@ class Gauge(Counter):
     def set(self, value: float, **labels) -> None:
         key = tuple(labels.get(k, "") for k in self.label_names)
         with self._lock:
+            self._admit(key)
             self._values[key] = value
 
     def dec(self, amount: float = 1.0, **labels) -> None:
@@ -146,11 +216,13 @@ class Histogram:
         help_: str,
         buckets=None,
         labels: tuple[str, ...] = (),
+        max_series: int = MAX_LABEL_SERIES,
     ):
         self.name = name
         self.help = help_
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
         self.label_names = tuple(labels)
+        self.max_series = max_series
         self._series: dict[tuple, _Series] = {}
         self._lock = threading.Lock()
         if not self.label_names:
@@ -164,6 +236,13 @@ class Histogram:
         with self._lock:
             s = self._series.get(key)
             if s is None:
+                if (
+                    self.label_names
+                    and len(self._series) >= self.max_series
+                ):
+                    raise MetricCardinalityError(
+                        self.name, self.max_series, key
+                    )
                 s = self._series[key] = _Series(len(self.buckets))
             s.sum += value
             s.total += 1
@@ -359,6 +438,38 @@ class ConsensusMetrics:
             "WAL records covered per group-commit fsync",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, float("inf")),
         )
+        # --- quorum-latency attribution (obs/cluster.py) ------------------
+        # arrival lag is measured from the ROUND'S FIRST VOTE of that
+        # type, so it isolates vote-spread from proposal latency
+        self.vote_arrival_lag = reg.histogram(
+            "consensus_vote_arrival_lag_seconds",
+            "Per-vote arrival lag behind the round's first vote of the "
+            "same type",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, float("inf")),
+            labels=("type",),
+        )
+        self.quorum_close_lag = reg.histogram(
+            "consensus_quorum_close_lag_seconds",
+            "First vote of the round to the vote that closed 2/3",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, float("inf")),
+            labels=("type",),
+        )
+        self.quorum_closer = reg.counter(
+            "consensus_quorum_closer_total",
+            "Times a validator's vote closed the 2/3 quorum",
+            ("validator", "type"),
+        )
+        self.proposal_gossip_seconds = reg.histogram(
+            "consensus_proposal_gossip_seconds",
+            "Proposer's proposal timestamp to our receipt, per sending "
+            "peer (includes the proposer-peer clock offset; read with "
+            "p2p_peer_clock_offset_seconds)",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     float("inf")),
+            labels=("peer",),
+        )
 
 
 class P2PMetrics:
@@ -382,6 +493,18 @@ class P2PMetrics:
         self.send_stall_seconds = reg.counter(
             "p2p_send_stall_seconds_total",
             "Time the send routine spent rate-throttled",
+        )
+        # NTP-style estimates from the timestamped ping/pong keepalive
+        # (mconn.py); peer labels go through bounded_label()
+        self.peer_clock_offset = reg.gauge(
+            "p2p_peer_clock_offset_seconds",
+            "Estimated peer wall-clock offset (peer minus us), EWMA",
+            ("peer",),
+        )
+        self.peer_rtt = reg.gauge(
+            "p2p_peer_rtt_seconds",
+            "Estimated peer round-trip time, EWMA",
+            ("peer",),
         )
 
 

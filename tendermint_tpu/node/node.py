@@ -482,6 +482,7 @@ class Node(Service):
             logger=self.logger,
             send_rate=config.p2p.send_rate,
             recv_rate=config.p2p.recv_rate,
+            ping_interval=config.p2p.ping_interval,
         )
         self.transport = transport
         self.switch = sw

@@ -3,7 +3,20 @@
 The layer every perf PR is judged against — see tracer.py for the design
 notes. Stdlib only."""
 
-from .report import ascii_timeline, attribution, attribution_table
+from .cluster import (
+    cluster_report,
+    estimate_offsets,
+    link_latencies,
+    merge_records,
+    normalize_dump,
+    report_text,
+)
+from .report import (
+    ascii_timeline,
+    attribution,
+    attribution_table,
+    side_by_side_timeline,
+)
 from .tracer import (
     DEFAULT_RING_SIZE,
     SpanRecord,
@@ -20,7 +33,14 @@ __all__ = [
     "ascii_timeline",
     "attribution",
     "attribution_table",
+    "cluster_report",
     "default_tracer",
+    "estimate_offsets",
     "flight_snapshot",
+    "link_latencies",
+    "merge_records",
+    "normalize_dump",
+    "report_text",
     "set_default_tracer",
+    "side_by_side_timeline",
 ]

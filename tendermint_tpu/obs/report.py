@@ -26,11 +26,16 @@ STEP_ORDER = (
 )
 
 
-def _pct(xs: list[float], q: float) -> float:
+def pct(xs: list[float], q: float) -> float:
+    """Index-based percentile (0 on empty) — the one implementation the
+    attribution tables, cluster reports, and bench artifacts share."""
     if not xs:
         return 0.0
     xs = sorted(xs)
     return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+_pct = pct
 
 
 def attribution(records: list[dict]) -> dict:
@@ -98,6 +103,63 @@ def ascii_timeline(records: list[dict], n_heights: int = 16) -> str:
                         f"{k}={v}" for k, v in sorted(r["fields"].items())
                     )
                 lines.append(f"  ! {r['name']:<26} {off:>9.2f}{extra}")
+    return "\n".join(lines)
+
+
+def side_by_side_timeline(
+    named_records: dict[str, list[dict]], n_heights: int = 16
+) -> str:
+    """Multi-node rendering: per height, one row per span name with one
+    duration column per node — a slow step on ONE validator stands out
+    against the same step's duration on its peers. Events render as a
+    per-node annotation count. `named_records` maps a display name (file
+    stem, moniker) to that node's record-dict list."""
+    nodes = list(named_records)
+    flights = {
+        n: flight_snapshot(
+            [SpanRecord.from_json(r) for r in named_records[n]], n_heights
+        )
+        for n in nodes
+    }
+    heights = sorted(set().union(*(set(f) for f in flights.values())))[
+        -n_heights:
+    ]
+    if not heights:
+        return "(no trace records)"
+    w = max(9, max(len(n) for n in nodes) + 1)
+    lines = []
+    for h in heights:
+        lines.append(f"height {h}")
+        lines.append(
+            f"  {'span (dur_ms)':<28} "
+            + " ".join(f"{n:>{w}}" for n in nodes)
+        )
+        # span rows: union of names, ordered by first appearance time
+        order: dict[str, float] = {}
+        durs: dict[str, dict[str, float]] = {}
+        events: dict[str, int] = {n: 0 for n in nodes}
+        for n in nodes:
+            for r in flights[n].get(h, []):
+                if r["kind"] != "span":
+                    events[n] += 1
+                    continue
+                order.setdefault(r["name"], r["t0"])
+                # a repeated span name (round retries) sums its durations
+                durs.setdefault(r["name"], {}).setdefault(n, 0.0)
+                durs[r["name"]][n] += r.get("dur", 0.0)
+        for name in sorted(order, key=order.get):
+            cells = [
+                (
+                    f"{durs[name][n] * 1e3:>{w}.2f}"
+                    if n in durs.get(name, {})
+                    else f"{'-':>{w}}"
+                )
+                for n in nodes
+            ]
+            lines.append(f"  {name:<28} " + " ".join(cells))
+        if any(events.values()):
+            cells = [f"{events[n]:>{w}}" for n in nodes]
+            lines.append(f"  {'! annotations':<28} " + " ".join(cells))
     return "\n".join(lines)
 
 

@@ -138,7 +138,19 @@ _NOP_SPAN = _NopSpan()
 class Tracer:
     """Thread-safe fixed-size ring of SpanRecords."""
 
-    def __init__(self, enabled: bool = False, ring_size: int = DEFAULT_RING_SIZE):
+    # how often the wall anchor is refreshed (seconds of perf_counter
+    # time); perf_counter and the wall clock drift apart on the order of
+    # ppm, so minutes-scale re-anchoring bounds the error at micro-
+    # seconds while a multi-hour soak against a creation-time anchor
+    # would accumulate milliseconds — enough to corrupt cross-node merge
+    DEFAULT_REANCHOR_INTERVAL = 300.0
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        ring_size: int = DEFAULT_RING_SIZE,
+        reanchor_interval_s: float = DEFAULT_REANCHOR_INTERVAL,
+    ):
         self.enabled = enabled
         self._ring: deque[SpanRecord] = deque(maxlen=max(16, ring_size))
         self._lock = threading.Lock()
@@ -146,6 +158,8 @@ class Tracer:
         # to the wall clock for cross-process correlation
         self.epoch = time.perf_counter()
         self.epoch_wall_ns = time.time_ns()
+        self.reanchor_interval_s = reanchor_interval_s
+        self._last_anchor_pc = self.epoch
 
     # --- recording --------------------------------------------------------
 
@@ -174,6 +188,7 @@ class Tracer:
         if parents:
             fields = dict(fields, parent=parents[-1])
         with self._lock:
+            self._maybe_reanchor_locked(time.perf_counter())
             self._ring.append(
                 SpanRecord(
                     name, t0 - self.epoch, dur, height, round, "span", fields
@@ -187,10 +202,12 @@ class Tracer:
         if not self.enabled:
             return
         with self._lock:
+            now = time.perf_counter()
+            self._maybe_reanchor_locked(now)
             self._ring.append(
                 SpanRecord(
                     name,
-                    time.perf_counter() - self.epoch,
+                    now - self.epoch,
                     0.0,
                     height,
                     round,
@@ -202,6 +219,34 @@ class Tracer:
     def now(self) -> float:
         """Current time on the tracer's own clock (seconds since epoch)."""
         return time.perf_counter() - self.epoch
+
+    # --- wall-anchor maintenance -----------------------------------------
+
+    def _maybe_reanchor_locked(self, now_pc: float) -> None:
+        if (
+            self.reanchor_interval_s > 0
+            and now_pc - self._last_anchor_pc >= self.reanchor_interval_s
+        ):
+            self._reanchor_locked(now_pc)
+
+    def _reanchor_locked(self, now_pc: float) -> None:
+        # re-derive what epoch_wall_ns SHOULD be given the current
+        # perf_counter<->wall relationship; record times (epoch-relative
+        # perf_counter) are untouched, only the wall mapping refreshes
+        self.epoch_wall_ns = time.time_ns() - int(
+            (now_pc - self.epoch) * 1e9
+        )
+        self._last_anchor_pc = now_pc
+
+    def reanchor(self) -> None:
+        """Refresh the monotonic->wall anchor now (normally automatic
+        every reanchor_interval_s on the recording path)."""
+        with self._lock:
+            self._reanchor_locked(time.perf_counter())
+
+    def wall_anchor_age_s(self) -> float:
+        """perf_counter seconds since the anchor was last refreshed."""
+        return time.perf_counter() - self._last_anchor_pc
 
     # --- reading ----------------------------------------------------------
 

@@ -11,23 +11,56 @@ Packet layout inside a SecretConnection message:
   byte 0: channel id (0xFE ping, 0xFF pong)
   byte 1: eof flag
   bytes 2..: payload chunk
+
+Ping/pong carries an NTP-style timestamp payload (cluster tracing): a
+ping ships the sender's wall+monotonic send time, the pong echoes it
+plus the responder's receive/transmit wall times, and the ping sender
+folds the four timestamps into a per-peer clock-offset/RTT EWMA
+(`clock_offset_s` / `rtt_s`). Empty payloads stay valid — a node that
+doesn't stamp its pings still keeps the keepalive alive, it just never
+produces clock samples. Offsets are observability-grade only: a peer
+can lie about t2/t3, so nothing consensus-critical may read them.
 """
 
 from __future__ import annotations
 
 import asyncio
+import struct
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
 from ..libs.flowrate import Monitor
 from ..libs.log import Logger, nop_logger
-from ..libs.metrics import P2PMetrics, default_metrics
+from ..libs.metrics import (
+    OTHER_LABEL,
+    P2PMetrics,
+    bounded_label,
+    default_metrics,
+)
 from ..obs import default_tracer
 
 MAX_PACKET_PAYLOAD = 1000
 _PING = 0xFE
 _PONG = 0xFF
+
+# ping payload: <qq  = (t1_wall_ns, t1_mono_ns) at the sender
+# pong payload: <qqqq = (t1_wall_ns, t1_mono_ns, t2_wall_ns, t3_wall_ns)
+#   t2 = responder receive wall time, t3 = responder transmit wall time
+_PING_FMT = "<qq"
+_PONG_FMT = "<qqqq"
+_PING_LEN = struct.calcsize(_PING_FMT)
+_PONG_LEN = struct.calcsize(_PONG_FMT)
+
+# EWMA weight for new clock samples; low enough to ride out one-off
+# scheduling spikes, high enough that ~10 pings converge
+_CLOCK_ALPHA = 0.2
+
+# sliding clock-filter depth (NTP keeps 8): the min-RTT sample is taken
+# over the last N pings, not all time, so a wall-clock step doesn't
+# leave a permanently stale offset pinned to an unbeatable old sample
+_CLOCK_WINDOW = 16
 
 # reference p2p/conn/connection.go defaultSendRate/defaultRecvRate:
 # 512000 B/s (500 KB/s) per connection; 0 disables throttling
@@ -82,6 +115,7 @@ class MConnection:
         recv_rate: int = DEFAULT_RECV_RATE,
         metrics: Optional[P2PMetrics] = None,
         logger: Optional[Logger] = None,
+        peer_id: str = "",
     ):
         self._conn = conn
         self._channels = {d.id: _Channel(d) for d in channels}
@@ -96,6 +130,19 @@ class MConnection:
         # public: peer-quality metrics read these (reference Status())
         self.send_monitor = Monitor()
         self.recv_monitor = Monitor()
+        # NTP-style per-peer clock estimate from timestamped ping/pong;
+        # None until the first complete sample
+        self.peer_id = peer_id
+        self.clock_offset_s: Optional[float] = None  # peer clock - ours
+        self.rtt_s: Optional[float] = None
+        # NTP clock-filter: the minimum-RTT sample over the last
+        # _CLOCK_WINDOW pings is the least queue-inflated one, so its
+        # offset is the sharpest estimate — the cluster merge prefers
+        # it over the EWMA
+        self._clock_window: deque = deque(maxlen=_CLOCK_WINDOW)
+        self.min_rtt_s: Optional[float] = None
+        self.min_rtt_offset_s: Optional[float] = None
+        self.clock_samples = 0
         self.logger = logger or nop_logger()
         self._tasks: list[asyncio.Task] = []
         self._send_signal = asyncio.Event()
@@ -224,9 +271,10 @@ class MConnection:
                 ch_id, eof, chunk = pkt
                 self.recv_monitor.update(len(chunk) + 2)
                 if ch_id == _PING:
-                    await self._conn.write(bytes([_PONG, 1]))
+                    await self._conn.write(self._pong_packet(chunk))
                     continue
                 if ch_id == _PONG:
+                    self._on_pong(chunk)
                     continue
                 ch = self._channels.get(ch_id)
                 if ch is None:
@@ -258,11 +306,66 @@ class MConnection:
         try:
             while self._running:
                 await asyncio.sleep(self._ping_interval)
-                await self._conn.write(bytes([_PING, 1]))
+                await self._conn.write(
+                    bytes([_PING, 1])
+                    + struct.pack(
+                        _PING_FMT, time.time_ns(), time.perf_counter_ns()
+                    )
+                )
         except asyncio.CancelledError:
             raise
         except Exception as e:
             await self._die(e)
+
+    # --- clock-offset estimation ------------------------------------------
+
+    @staticmethod
+    def _pong_packet(ping_payload: bytes) -> bytes:
+        """Echo the ping's timestamps plus our receive/transmit wall
+        times; a payload-less (pre-extension) ping gets a bare pong."""
+        if len(ping_payload) < _PING_LEN:
+            return bytes([_PONG, 1])
+        t1_wall, t1_mono = struct.unpack_from(_PING_FMT, ping_payload)
+        t2 = time.time_ns()
+        # t3 is stamped immediately before the write; at this packet size
+        # the t2/t3 gap is the cost of one struct.pack
+        return bytes([_PONG, 1]) + struct.pack(
+            _PONG_FMT, t1_wall, t1_mono, t2, time.time_ns()
+        )
+
+    def _on_pong(self, payload: bytes) -> None:
+        """Fold one NTP sample (t1..t4) into the offset/RTT EWMAs."""
+        if len(payload) < _PONG_LEN:
+            return
+        t1_wall, t1_mono, t2, t3 = struct.unpack_from(_PONG_FMT, payload)
+        t4_wall = time.time_ns()
+        t4_mono = time.perf_counter_ns()
+        # RTT from OUR monotonic clock (immune to either wall clock
+        # stepping mid-flight), minus the responder's processing time
+        rtt = (t4_mono - t1_mono - (t3 - t2)) / 1e9
+        if rtt < 0:  # stale echo / clock anomaly: discard the sample
+            return
+        offset = ((t2 - t1_wall) + (t3 - t4_wall)) / 2e9
+        if self.clock_samples == 0:
+            self.clock_offset_s = offset
+            self.rtt_s = rtt
+        else:
+            self.clock_offset_s += _CLOCK_ALPHA * (offset - self.clock_offset_s)
+            self.rtt_s += _CLOCK_ALPHA * (rtt - self.rtt_s)
+        self._clock_window.append((rtt, offset))
+        self.min_rtt_s, self.min_rtt_offset_s = min(self._clock_window)
+        self.clock_samples += 1
+        if self.peer_id:
+            label = bounded_label("p2p_peer_clock", self.peer_id)
+            if label != OTHER_LABEL:
+                # gauges are last-write-wins: an "_other" series shared
+                # by every overflow peer would flap between unrelated
+                # peers' offsets — wrong data, not coarse data. Overflow
+                # peers stay observable via dump_traces' peer_clock.
+                self.metrics.peer_clock_offset.set(
+                    self.clock_offset_s, peer=label
+                )
+                self.metrics.peer_rtt.set(self.rtt_s, peer=label)
 
     async def _die(self, err: Exception) -> None:
         if self._errored or not self._running:

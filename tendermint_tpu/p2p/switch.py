@@ -68,6 +68,7 @@ class Switch(Service):
         recv_rate: int = 0,
         max_dial_attempts: int = MAX_DIAL_ATTEMPTS,
         dial_rng: Optional[random.Random] = None,
+        ping_interval: float = 10.0,
     ):
         super().__init__("p2p-switch", logger)
         self.transport = transport
@@ -80,6 +81,9 @@ class Switch(Service):
         # nodes pass config.p2p values, tests default to unlimited
         self.send_rate = send_rate
         self.recv_rate = recv_rate
+        # keepalive cadence, which is also the clock-offset sampling rate
+        # (tests shrink it so offset EWMAs converge inside a short run)
+        self.ping_interval = ping_interval
         self.dialing: set[str] = set()
         self._persistent_addrs: list[NetAddress] = []
         # addresses with a live _dial_with_retry loop (including its
@@ -281,8 +285,10 @@ class Switch(Service):
             descs,
             on_receive,
             on_error,
+            ping_interval=self.ping_interval,
             send_rate=self.send_rate,
             recv_rate=self.recv_rate,
+            peer_id=info.node_id,
         )
         peer = Peer(info, sconn, mconn, outbound, addr)
         peer_holder.append(peer)
@@ -324,6 +330,19 @@ class Switch(Service):
         await peer.stop()
         for r in self.reactors.values():
             await r.remove_peer(peer, reason)
+
+    def peer_clock_table(self) -> dict:
+        """Per-peer NTP offset/RTT estimates (timestamped ping/pong,
+        mconn.py), keyed by peer node id; peers without a complete
+        sample are omitted. The `peer_clock` section of `dump_traces`,
+        shared by the RPC core and the in-proc test harness so both dump
+        shapes stay identical."""
+        out = {}
+        for pid, p in self.peers.items():
+            info = p.clock_info()
+            if info.get("samples"):
+                out[pid] = info
+        return out
 
     def broadcast(self, channel_id: int, msg: bytes) -> None:
         """Best-effort send to every peer (reference Switch.Broadcast :264)."""

@@ -66,6 +66,29 @@ class Peer:
 
     try_send = send
 
+    # --- clock estimate (timestamped ping/pong, mconn.py) ----------------
+
+    @property
+    def clock_offset_s(self):
+        """Estimated peer wall-clock offset (peer minus us), or None
+        before the first ping/pong sample."""
+        return self.mconn.clock_offset_s
+
+    @property
+    def rtt_s(self):
+        return self.mconn.rtt_s
+
+    def clock_info(self) -> dict:
+        """The per-peer entry `dump_traces` exports for cluster-trace
+        offset estimation (obs/cluster.py)."""
+        return {
+            "offset_s": self.mconn.clock_offset_s,
+            "rtt_s": self.mconn.rtt_s,
+            "min_rtt_s": self.mconn.min_rtt_s,
+            "min_rtt_offset_s": self.mconn.min_rtt_offset_s,
+            "samples": self.mconn.clock_samples,
+        }
+
     async def stop(self) -> None:
         await self.mconn.stop()
 

@@ -375,7 +375,10 @@ class RPCCore:
 
     def dump_traces(self, format=None, heights=None, **_kw) -> dict:
         """Flight-recorder dump (tendermint_tpu/obs). Formats:
-        - default: the raw span ring + the last-N-heights flight view;
+        - default: the raw span ring + the last-N-heights flight view,
+          plus the node's identity and per-peer clock table so
+          tools/cluster_trace.py can merge dumps from several validators
+          onto one timeline;
         - format=chrome: a Chrome trace_event JSON object — save
           `result.trace` to a file and load it in Perfetto."""
         from .. import obs
@@ -400,6 +403,15 @@ class RPCCore:
         return {
             "enabled": tracer.enabled,
             "epoch_wall_ns": tracer.epoch_wall_ns,
+            "node_id": getattr(
+                getattr(self.node, "node_key", None), "id", ""
+            ),
+            "moniker": getattr(
+                getattr(getattr(self.node, "config", None), "base", None),
+                "moniker",
+                "",
+            ),
+            "peer_clock": self._peer_clock(),
             "records": recs,
             "flight": {
                 str(h): rows
@@ -407,6 +419,10 @@ class RPCCore:
             },
             "attribution": obs.attribution(recs),
         }
+
+    def _peer_clock(self) -> dict:
+        sw = getattr(self.node, "switch", None)
+        return sw.peer_clock_table() if sw is not None else {}
 
     def consensus_params(self, height=None, **_kw) -> dict:
         state = self.node.consensus.state

@@ -22,6 +22,15 @@ class VoteType(enum.IntEnum):
     PRECOMMIT = canonical.PRECOMMIT_TYPE
 
 
+# canonical display names: the cluster-trace merge joins `type` fields
+# across quorum.* (height_vote_set.py) and gossip.* (consensus/
+# reactor.py) events, so every emitter must use this one map
+VOTE_TYPE_NAMES = {
+    int(VoteType.PREVOTE): "prevote",
+    int(VoteType.PRECOMMIT): "precommit",
+}
+
+
 MAX_VOTE_BYTES = 2048  # generous bound incl. BLS signature
 
 

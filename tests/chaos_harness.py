@@ -17,13 +17,17 @@ from tendermint_tpu.p2p.node_info import NodeInfo
 from tendermint_tpu.p2p.switch import Switch
 from tendermint_tpu.p2p.transport import MultiplexTransport, NetAddress
 
-from tests.helpers import make_genesis, make_validators
+from tests.helpers import (
+    make_genesis,
+    make_validators,
+    make_weighted_validators,
+)
 from tests.test_consensus import make_node
 
 NETWORK = "chaos-chain"
 
 
-def _wire_node(cs, nk):
+def _wire_node(cs, nk, ping_interval: float = 10.0):
     """Fresh transport + switch + consensus reactor for one node."""
     transport = None
     sw = None
@@ -37,20 +41,37 @@ def _wire_node(cs, nk):
         )
 
     transport = MultiplexTransport(nk, node_info)
-    sw = Switch(transport)
+    sw = Switch(transport, ping_interval=ping_interval)
     sw.add_reactor("consensus", ConsensusReactor(cs))
     return transport, sw
 
 
-def build_chaos_handles(n: int = 4) -> list[NodeHandle]:
-    """n validator NodeHandles (not yet listening/started)."""
-    vs, pvs = make_validators(n)
+def build_chaos_handles(
+    n: int = 4,
+    tracer_factory=None,
+    ping_interval: float = 10.0,
+    powers=None,
+) -> list[NodeHandle]:
+    """n validator NodeHandles (not yet listening/started).
+
+    `tracer_factory(name) -> Tracer` gives each node its OWN span ring
+    (cluster tracing: obs.cluster merges the per-node dumps); default
+    None keeps every node on the process-wide tracer. A small
+    `ping_interval` makes the peer clock-offset EWMAs converge inside a
+    short run. `powers` gives per-validator voting powers (n_i holds the
+    key of validator index i in the sorted set)."""
+    if powers is not None:
+        vs, pvs = make_weighted_validators(powers)
+        n = len(powers)
+    else:
+        vs, pvs = make_validators(n)
     genesis = make_genesis(vs)
     handles: list[NodeHandle] = []
     for i, pv in enumerate(pvs):
-        cs, app, l2, bs, ss = make_node(vs, pv, genesis)
+        tracer = tracer_factory(f"n{i}") if tracer_factory else None
+        cs, app, l2, bs, ss = make_node(vs, pv, genesis, tracer=tracer)
         nk = NodeKey.generate()
-        transport, sw = _wire_node(cs, nk)
+        transport, sw = _wire_node(cs, nk, ping_interval=ping_interval)
         handles.append(
             NodeHandle(
                 name=f"n{i}",
@@ -70,7 +91,11 @@ def _make_restart(handles: list[NodeHandle]):
         """Rebuild p2p around the same consensus state (restart
         semantics: same privval + stores, fresh node key) and rejoin."""
         handle.node_key = NodeKey.generate()
-        handle.transport, handle.switch = _wire_node(handle.cs, handle.node_key)
+        handle.transport, handle.switch = _wire_node(
+            handle.cs,
+            handle.node_key,
+            ping_interval=handle.switch.ping_interval,
+        )
         net.install(handle)
         await handle.transport.listen()
         await handle.switch.start()
@@ -113,6 +138,20 @@ async def stop_mesh(handles: list[NodeHandle]) -> None:
             continue
         await h.cs.stop()
         await h.switch.stop()
+
+
+def node_dump(handle: NodeHandle) -> dict:
+    """A `dump_traces`-shaped dict for one in-proc node — the input
+    obs.cluster/tools/cluster_trace.py consume. Only meaningful when the
+    mesh was built with per-node tracers (tracer_factory)."""
+    tracer = handle.cs.tracer
+    return {
+        "node_id": handle.node_key.id,
+        "moniker": handle.name,
+        "epoch_wall_ns": tracer.epoch_wall_ns,
+        "records": [r.to_json() for r in tracer.records()],
+        "peer_clock": handle.switch.peer_clock_table(),
+    }
 
 
 async def chain_hashes(handles: list[NodeHandle], height: int) -> set:

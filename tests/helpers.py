@@ -26,6 +26,19 @@ def make_validators(n: int, power: int = 10, seed: bytes = b"val"):
     return vs, [by_addr[v.address] for v in vs.validators]
 
 
+def make_weighted_validators(powers, seed: bytes = b"val"):
+    """Like make_validators but with per-validator voting powers; the
+    returned privvals are ordered to match the SORTED set, so pvs[i] is
+    validator index i (a quorum-attribution test needs one validator
+    whose vote every 2/3 requires)."""
+    pvs = [MockPV.from_secret(seed + b"%d" % i) for i in range(len(powers))]
+    vs = ValidatorSet(
+        [Validator(pv.get_pub_key(), p) for pv, p in zip(pvs, powers)]
+    )
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    return vs, [by_addr[v.address] for v in vs.validators]
+
+
 def make_genesis(vs: ValidatorSet, chain_id: str = CHAIN_ID) -> GenesisDoc:
     doc = GenesisDoc(
         chain_id=chain_id,

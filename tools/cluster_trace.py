@@ -1,0 +1,123 @@
+"""Merge per-validator flight-recorder dumps into one cluster timeline.
+
+Pulls `dump_traces` from every validator (or reads saved dump files),
+estimates each node's clock offset from the ping/pong NTP tables (min-RTT
+paths through the peer graph, so one delayed link can't bias the merge;
+wall anchors as fallback), rebases all spans onto one reference timeline,
+and emits:
+
+- a merged Chrome trace_event JSON (one Perfetto process per node),
+- the per-height "slowest path" report: proposer -> proposal gossip per
+  node -> quorum-closing vote, plus link and straggler rankings.
+
+Usage:
+    python tools/cluster_trace.py dump0.json dump1.json ... [options]
+    python tools/cluster_trace.py --rpc host:26657 --rpc host:26658 ...
+
+Options:
+    --out merged_trace.json   write the merged Perfetto trace
+    --json report.json        write the full cluster-report JSON
+    --reference NAME          reference node (default: first dump)
+    --heights N               last N heights in the report (default 16)
+
+Inputs may be raw `dump_traces` responses, JSON-RPC envelopes, or the
+`{"node_id", "records", ...}` dumps tools/soak.py attaches on divergence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu import obs
+
+
+def fetch_dump(addr: str, timeout: float = 10.0) -> dict:
+    """Pull dump_traces from a node's JSON-RPC endpoint (host:port or a
+    full http URL)."""
+    url = addr if addr.startswith("http") else f"http://{addr}"
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": "dump_traces", "params": {}}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        doc = json.load(resp)
+    if "error" in doc and doc["error"]:
+        raise RuntimeError(f"{addr}: RPC error {doc['error']}")
+    return doc
+
+
+def load_dumps(paths: list[str], rpcs: list[str]) -> list[dict]:
+    dumps = []
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        name = os.path.splitext(os.path.basename(p))[0]
+        dumps.append(obs.normalize_dump(doc, name=name))
+    for addr in rpcs:
+        dumps.append(obs.normalize_dump(fetch_dump(addr)))
+    # node ids must be distinct for the offset graph; synthesize for
+    # id-less dumps (hand-built files)
+    seen: set[str] = set()
+    for i, d in enumerate(dumps):
+        if not d["node_id"] or d["node_id"] in seen:
+            d["node_id"] = f"{d['node_id'] or 'anon'}#{i}"
+        seen.add(d["node_id"])
+    return dumps
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", help="dump_traces JSON files")
+    ap.add_argument("--rpc", action="append", default=[],
+                    help="pull dump_traces from host:port (repeatable)")
+    ap.add_argument("--out", help="write merged Perfetto trace JSON here")
+    ap.add_argument("--json", dest="json_out",
+                    help="write the cluster-report JSON here")
+    ap.add_argument("--reference", default="",
+                    help="reference node name or id (default: first dump)")
+    ap.add_argument("--heights", type=int, default=16)
+    args = ap.parse_args(argv)
+    if not args.paths and not args.rpc:
+        ap.error("need at least one dump file or --rpc endpoint")
+
+    dumps = load_dumps(args.paths, args.rpc)
+    ref = ""
+    if args.reference:  # accept a display name or a node id
+        matches = [
+            d
+            for d in dumps
+            if args.reference in (d["name"], d["node_id"])
+        ]
+        if not matches:
+            ap.error(
+                f"--reference {args.reference!r} matches no dump "
+                f"(names: {[d['name'] for d in dumps]})"
+            )
+        ref = matches[0]["node_id"]
+    merge = obs.merge_records(dumps, reference=ref)
+    report = obs.cluster_report(dumps, n_heights=args.heights, merge=merge)
+    if args.out:
+        from tendermint_tpu.obs.cluster import to_chrome_trace
+
+        with open(args.out, "w") as f:
+            json.dump(to_chrome_trace(merge[2], dumps), f)
+        print(f"# merged Perfetto trace -> {args.out}", file=sys.stderr)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"# cluster report JSON -> {args.json_out}", file=sys.stderr)
+    print(obs.report_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

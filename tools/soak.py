@@ -33,6 +33,39 @@ from tendermint_tpu.chaos import ScenarioRunner, random_scenario
 from tendermint_tpu.chaos.scenario import default_seed
 
 
+def _collect_dumps(handles, chaos_tracer) -> list[dict]:
+    """Per-node dump_traces-shaped dicts, plus the process-wide ring's
+    chaos/link annotations as a pseudo node."""
+    from tests.chaos_harness import node_dump
+
+    dumps = [obs.normalize_dump(node_dump(h)) for h in handles]
+    chaos_records = [r.to_json() for r in chaos_tracer.records()]
+    if chaos_records:
+        dumps.append(
+            obs.normalize_dump(
+                {
+                    "node_id": "_chaos",
+                    "moniker": "_chaos",
+                    "epoch_wall_ns": chaos_tracer.epoch_wall_ns,
+                    "records": chaos_records,
+                    "peer_clock": {},
+                }
+            )
+        )
+    return dumps
+
+
+def _merge(dumps: list[dict]):
+    """Rebase the dumps onto one timeline with explicit wall-anchor
+    offsets — one process, one clock, so the anchors ARE ground truth
+    and NTP estimation over chaos-delayed links would only import bias.
+    Only run on the divergence path: the happy path's attribution never
+    reads timestamps, so the rebase+sort would be wasted work there."""
+    from tendermint_tpu.obs.cluster import wall_anchor_offsets
+
+    return obs.merge_records(dumps, offsets=wall_anchor_offsets(dumps))
+
+
 async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
     from tests.chaos_harness import (
         build_chaos_handles,
@@ -41,13 +74,20 @@ async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
         stop_mesh,
     )
 
-    # flight recorder on for every iteration: a diverging seed ships with
-    # its per-height step timeline, not just the scenario plan
+    # flight recorder on for every iteration: a diverging seed ships
+    # with its per-height step timeline, not just the scenario plan.
+    # Each node gets its OWN ring (cluster tracing) so a divergence also
+    # ships the merged cross-validator report; the process-wide default
+    # ring keeps collecting the chaos/link annotations.
     tracer = obs.default_tracer()
     tracer.enabled = True
     tracer.clear()
 
-    handles = build_chaos_handles(n_nodes)
+    handles = build_chaos_handles(
+        n_nodes,
+        tracer_factory=lambda name: obs.Tracer(enabled=True),
+        ping_interval=1.0,
+    )
     scenario = random_scenario(seed, [h.name for h in handles])
     runner = ScenarioRunner(handles, scenario)
     await start_mesh(handles)
@@ -59,26 +99,31 @@ async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
             for name, seq in heights.items()
             if runner.nodes[name].alive
         )
-        records = [r.to_json() for r in tracer.records()]
+        dumps = _collect_dumps(handles, tracer)
+        all_records = [r for d in dumps for r in d["records"]]
         out = {
             "seed": seed,
             "ok": converged,
             "heights": {k: (v[-1] if v else 0) for k, v in heights.items()},
             "forks": len(hashes),
-            "latency_attribution": obs.attribution(records),
+            "latency_attribution": obs.attribution(all_records),
             "plan": runner.plan_jsonl().decode(),
         }
         if not converged:
-            out["trace_report"] = obs.ascii_timeline(records)
+            merge = _merge(dumps)
+            out["trace_report"] = obs.ascii_timeline(merge[2])
+            out["cluster_report"] = obs.cluster_report(dumps, merge=merge)
         return out
     except TimeoutError as e:
-        records = [r.to_json() for r in tracer.records()]
+        dumps = _collect_dumps(handles, tracer)
+        merge = _merge(dumps)
         return {
             "seed": seed,
             "ok": False,
             "error": str(e),
-            "latency_attribution": obs.attribution(records),
-            "trace_report": obs.ascii_timeline(records),
+            "latency_attribution": obs.attribution(merge[2]),
+            "trace_report": obs.ascii_timeline(merge[2]),
+            "cluster_report": obs.cluster_report(dumps, merge=merge),
             "plan": runner.plan_jsonl().decode(),
         }
     finally:
@@ -123,6 +168,8 @@ def main() -> int:
             )
             if res.get("trace_report"):
                 print(res["trace_report"], file=sys.stderr)
+            if res.get("cluster_report"):
+                print(obs.report_text(res["cluster_report"]), file=sys.stderr)
             print(json.dumps(res))
             return 1
         it += 1

@@ -7,8 +7,13 @@ plus the aggregate latency-attribution table — the artifact a failing
 soak seed ships with, so a divergence report explains where the stalled
 height's time went without re-running anything.
 
+With several dumps (one per validator) the per-height tables render
+side-by-side: one duration column per node, so a step that is slow on
+ONE validator stands out against its peers. For clock-rebased merging
+and the slowest-path report, use tools/cluster_trace.py.
+
 Usage:
-    python tools/trace_report.py dump.json [--heights N]
+    python tools/trace_report.py dump.json [dump2.json ...] [--heights N]
     curl -s localhost:26657/dump_traces | python tools/trace_report.py -
 """
 
@@ -21,7 +26,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tendermint_tpu.obs import ascii_timeline, attribution_table
+from tendermint_tpu.obs import (
+    ascii_timeline,
+    attribution_table,
+    side_by_side_timeline,
+)
 
 
 def extract_records(doc) -> list[dict]:
@@ -64,18 +73,58 @@ def render(doc, n_heights: int = 16) -> str:
     )
 
 
+def render_many(named_docs: dict[str, object], n_heights: int = 16) -> str:
+    """Side-by-side node columns plus the pooled attribution table."""
+    named_records = {
+        name: extract_records(doc) for name, doc in named_docs.items()
+    }
+    pooled = [r for recs in named_records.values() for r in recs]
+    return "\n\n".join(
+        [
+            side_by_side_timeline(named_records, n_heights),
+            attribution_table(pooled),
+        ]
+    )
+
+
+def _load(path: str):
+    if path == "-":
+        return json.load(sys.stdin)
+    with open(path) as f:
+        return json.load(f)
+
+
+def _name_for(path: str, doc, taken: set) -> str:
+    name = ""
+    if isinstance(doc, dict):
+        name = doc.get("moniker") or (doc.get("node_id") or "")[:12]
+    if not name:
+        name = os.path.splitext(os.path.basename(path))[0] or "stdin"
+    base, i = name, 1
+    while name in taken:
+        i += 1
+        name = f"{base}#{i}"
+    taken.add(name)
+    return name
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("path", help="dump file, or - for stdin")
+    ap.add_argument("paths", nargs="+",
+                    help="dump file(s), or - for stdin; several files "
+                         "render side-by-side node columns")
     ap.add_argument("--heights", type=int, default=16,
                     help="show the last N heights (default 16)")
     args = ap.parse_args(argv)
-    if args.path == "-":
-        doc = json.load(sys.stdin)
-    else:
-        with open(args.path) as f:
-            doc = json.load(f)
-    print(render(doc, args.heights))
+    if len(args.paths) == 1:
+        print(render(_load(args.paths[0]), args.heights))
+        return 0
+    named: dict[str, object] = {}
+    taken: set = set()
+    for p in args.paths:
+        doc = _load(p)
+        named[_name_for(p, doc, taken)] = doc
+    print(render_many(named, args.heights))
     return 0
 
 
