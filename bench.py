@@ -23,6 +23,7 @@ here reflect that executor, not TPU silicon capability.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -37,6 +38,14 @@ from tendermint_tpu.libs.jax_cache import set_compile_cache_env
 set_compile_cache_env()
 
 BASELINE_SERIAL_SIGS_PER_S = 15_000.0
+
+
+def _meta_block(live: bool = True) -> dict:
+    """Artifact provenance stamp — shared with multichip_capture via
+    chaos/backend_guard.meta_block (see its docstring)."""
+    from tendermint_tpu.chaos.backend_guard import meta_block
+
+    return meta_block(live=live)
 
 
 def _reg_snapshot() -> dict:
@@ -157,6 +166,7 @@ def _degrade(status) -> None:
         "unit": "sigs/s/chip",
         "vs_baseline": 0.0,
         "tunnel_down": status.kind in ("tunnel_down", "timeout"),
+        "meta": _meta_block(live=False),
         "note": (
             "device backend unreachable; bench degraded instead of "
             "hanging — last valid device capture stands"
@@ -230,10 +240,51 @@ def _degrade(status) -> None:
 def main() -> None:
     from tendermint_tpu.chaos.backend_guard import probe_backend
 
+    ap = argparse.ArgumentParser(description="tpu-tendermint bench")
+    ap.add_argument(
+        "--require-backend",
+        default=os.environ.get("TM_TPU_BENCH_REQUIRE_BACKEND", ""),
+        help="fail (structured artifact, non-zero exit, NO fallback "
+        "row) unless the probed jax backend equals this platform "
+        "(e.g. 'tpu'). The r04-r06 regression class recorded the "
+        "sanitized CPU fallback as a bench result; this flag makes a "
+        "missing device a loud error instead of a quiet 0.14x row.",
+    )
+    args = ap.parse_args()
+
     # the CPU-fallback child already probed and pinned JAX_PLATFORMS=cpu;
     # re-probing there would recurse
     if os.environ.get("TM_TPU_BENCH_CHILD") != "1":
         status = probe_backend()
+        if args.require_backend:
+            got = status.backend if status.available else None
+            if got != args.require_backend:
+                err = (
+                    status.error
+                    if not status.available
+                    else (
+                        f"probed backend {got!r} != required "
+                        f"{args.require_backend!r}"
+                    )
+                )
+                print(
+                    json.dumps(
+                        {
+                            "rc": 1,
+                            "error": err,
+                            "backend": got,
+                            "kind": (
+                                status.kind
+                                if not status.available
+                                else "backend_mismatch"
+                            ),
+                            "fallback": "none",
+                            "required_backend": args.require_backend,
+                            "meta": _meta_block(live=False),
+                        }
+                    )
+                )
+                raise SystemExit(1)
         if not status.available:
             _degrade(status)
             return
@@ -350,6 +401,7 @@ def main() -> None:
                 "vs_baseline": round(
                     cached_rate / BASELINE_SERIAL_SIGS_PER_S, 3
                 ),
+                "meta": _meta_block(),
                 **_shape_stats(before_headline),
                 # the rest of the bench family (VERDICT r2 weak #7: one
                 # recorded metric left regressions in the other paths

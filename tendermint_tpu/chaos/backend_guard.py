@@ -129,6 +129,31 @@ def probe_backend(
     )
 
 
+def meta_block(live: bool = True) -> dict:
+    """Provenance stamp for every BENCH/MULTICHIP artifact: which
+    backend, device count and jax produced the numbers. The r04-r06
+    regression class was a sanitized CPU fallback silently recorded as
+    the bench row — with the meta block a fallback row is detectable
+    after the fact even if the fallback flags are lost. live=False
+    builds the stamp WITHOUT importing jax (the failure paths, where a
+    jax init may hang)."""
+    if live:
+        import jax
+
+        return {
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "jax_version": jax.__version__,
+        }
+    try:
+        from importlib.metadata import version
+
+        jv = version("jax")
+    except Exception:
+        jv = None
+    return {"backend": None, "device_count": 0, "jax_version": jv}
+
+
 def fallback_artifact(
     status: BackendStatus,
     fallback: str = "none",

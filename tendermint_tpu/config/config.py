@@ -246,6 +246,16 @@ class SchedulerConfig:
     # comma-separated canonical pad buckets, e.g. "8,64,512,2048,8192";
     # "" = the built-in ladder (crypto/shape_registry)
     bucket_ladder: str = ""
+    # shard coalesced rounds across ALL local devices (parallel/mesh.py
+    # over every visible chip of the backend): the data-parallel
+    # multi-chip verify plane (PERF_ANALYSIS §13). Equivalent to
+    # [tpu] ici_parallelism = 0 but scoped to the scheduler knob set;
+    # explicit [tpu] axes take precedence. No-op on 1 device.
+    mesh_enable: bool = False
+    # rounds below this row count stay single-device even under a mesh
+    # — shard + all-gather overhead only amortizes on bulk rounds, and
+    # live consensus rounds (O(validators) rows) want raw latency
+    mesh_min_rows: int = 1024
     # ahead-of-time compile/load the ladder's verify programs on the
     # node's warm thread at startup (~6 programs/tier; zero per-shape
     # loads mid-height afterwards) and persist the manifest below.
@@ -256,6 +266,8 @@ class SchedulerConfig:
     def validate_basic(self) -> None:
         if self.max_batch < 1:
             raise ValueError("scheduler.max_batch must be >= 1")
+        if self.mesh_min_rows < 1:
+            raise ValueError("scheduler.mesh_min_rows must be >= 1")
         ladder = self.ladder()
         if ladder is not None and (not ladder or min(ladder) < 1):
             raise ValueError(

@@ -14,9 +14,13 @@ This module owns that ladder and the process-wide accounting:
   verify dispatch uses (BatchVerifier and the dispatch scheduler both
   route here, so a config override changes every caller at once);
 - `record_dispatch(tier, bucket)` — called by BatchVerifier._dispatch
-  for every device round, counting distinct (tier, bucket) program
-  shapes and total dispatches. bench.py snapshots this around each
-  metric so shape/dispatch regressions land in the JSON artifact
+  for every device round, counting distinct (tier, bucket, devices)
+  program shapes and total dispatches. A mesh-sharded round compiles a
+  DIFFERENT XLA program than the single-device round at the same
+  bucket (the sharding is part of the lowering), so `devices` is a
+  first-class shape dimension: per-mesh programs stay inside the same
+  budget accounting as everything else. bench.py snapshots this around
+  each metric so shape/dispatch regressions land in the JSON artifact
   instead of cProfile archaeology, and the shape-budget regression
   test asserts the bench verify family stays within a bounded ladder.
 
@@ -47,13 +51,16 @@ class ShapeRegistry:
             raise ValueError(f"invalid bucket ladder {ladder!r}")
         self.ladder = ladder
         self._lock = threading.Lock()
-        # tier -> set of (bucket, rows): a program's shape is the batch
-        # bucket AND any secondary operand dimension that varies (the
-        # cached tiers' table-store row count — _TableCache grows it in
-        # powers of two, so rows has its own small ladder; rows=0 for
-        # tiers without one)
-        self._shapes: dict[str, set[tuple[int, int]]] = {}
+        # tier -> set of (bucket, rows, devices): a program's shape is
+        # the batch bucket AND any secondary operand dimension that
+        # varies — the cached tiers' table-store row count (_TableCache
+        # grows it in powers of two, so rows has its own small ladder;
+        # rows=0 for tiers without one) and the mesh device count the
+        # batch axis shards over (1 = unsharded; a sharded program is a
+        # distinct lowering even at the same bucket)
+        self._shapes: dict[str, set[tuple[int, int, int]]] = {}
         self._dispatches = 0
+        self._sharded_dispatches = 0
 
     # --- bucketing --------------------------------------------------------
 
@@ -72,16 +79,20 @@ class ShapeRegistry:
     # --- accounting -------------------------------------------------------
 
     def record_dispatch(
-        self, tier: str, bucket: int, rows: int = 0
+        self, tier: str, bucket: int, rows: int = 0, devices: int = 1
     ) -> bool:
-        """Count one device dispatch; True iff (tier, bucket, rows) is a
-        shape this registry has not seen before. `rows` is the secondary
-        shape dimension for tiers whose programs also vary with the
-        table-store allocation (0 when not applicable)."""
+        """Count one device dispatch; True iff (tier, bucket, rows,
+        devices) is a shape this registry has not seen before. `rows` is
+        the secondary shape dimension for tiers whose programs also vary
+        with the table-store allocation (0 when not applicable);
+        `devices` is the mesh shard count of the batch axis (1 =
+        unsharded)."""
         with self._lock:
             self._dispatches += 1
+            if devices > 1:
+                self._sharded_dispatches += 1
             seen = self._shapes.setdefault(tier, set())
-            key = (int(bucket), int(rows))
+            key = (int(bucket), int(rows), int(devices))
             if key in seen:
                 return False
             seen.add(key)
@@ -97,16 +108,24 @@ class ShapeRegistry:
         with self._lock:
             return self._dispatches
 
-    def shapes_by_tier(self) -> dict[str, tuple[tuple[int, int], ...]]:
-        """tier -> sorted ((bucket, rows), ...) program shapes seen."""
+    def sharded_dispatch_count(self) -> int:
+        """Dispatches whose batch axis was sharded over > 1 device."""
+        with self._lock:
+            return self._sharded_dispatches
+
+    def shapes_by_tier(
+        self,
+    ) -> dict[str, tuple[tuple[int, int, int], ...]]:
+        """tier -> sorted ((bucket, rows, devices), ...) shapes seen."""
         with self._lock:
             return {t: tuple(sorted(s)) for t, s in self._shapes.items()}
 
     def buckets_by_tier(self) -> dict[str, tuple[int, ...]]:
-        """tier -> sorted distinct batch buckets (rows collapsed)."""
+        """tier -> sorted distinct batch buckets (rows/devices
+        collapsed)."""
         with self._lock:
             return {
-                t: tuple(sorted({b for b, _ in s}))
+                t: tuple(sorted({b for b, _, _ in s}))
                 for t, s in self._shapes.items()
             }
 
@@ -119,6 +138,7 @@ class ShapeRegistry:
                     len(s) for s in self._shapes.values()
                 ),
                 "device_dispatch_count": self._dispatches,
+                "sharded_dispatch_count": self._sharded_dispatches,
                 "shapes_by_tier": {
                     t: sorted(list(k) for k in s)
                     for t, s in self._shapes.items()
@@ -127,7 +147,10 @@ class ShapeRegistry:
 
     @staticmethod
     def delta(before: dict, after: dict) -> dict:
-        """New-shapes/dispatches between two snapshots."""
+        """New-shapes/dispatches between two snapshots. The sharded
+        count rides next to device_dispatch_count so a bench artifact
+        shows whether a metric's rounds actually went through the mesh
+        (a CPU-fallback or meshless run records sharded = 0)."""
         return {
             "distinct_program_shapes": (
                 after["distinct_program_shapes"]
@@ -136,6 +159,10 @@ class ShapeRegistry:
             "device_dispatch_count": (
                 after["device_dispatch_count"]
                 - before["device_dispatch_count"]
+            ),
+            "sharded_dispatch_count": (
+                after.get("sharded_dispatch_count", 0)
+                - before.get("sharded_dispatch_count", 0)
             ),
         }
 

@@ -607,6 +607,15 @@ class SchedulerMetrics:
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
                      float("inf")),
         )
+        self.mesh_devices = reg.gauge(
+            "verify_mesh_devices",
+            "Devices in the verify mesh the scheduler dispatches over "
+            "(1 = single-device / no mesh)",
+        )
+        self.dispatch_sharded = reg.counter(
+            "verify_dispatch_sharded_total",
+            "Device verify rounds row-sharded across > 1 mesh device",
+        )
 
 
 class EvidenceMetrics:

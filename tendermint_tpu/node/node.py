@@ -204,7 +204,10 @@ class Node(Service):
                 )
         # [tpu] mesh axes -> env, so the process-wide default_verifier()
         # (constructed lazily by whichever reactor first verifies) builds
-        # the sharded verifier per config (parallel/mesh.py)
+        # the sharded verifier per config (parallel/mesh.py).
+        # [scheduler] mesh_enable is the one-knob version: shard the
+        # verify plane over ALL local devices (ici=0); explicit [tpu]
+        # axes win when both are set (setdefault ordering below).
         if config.tpu.ici_parallelism != 1 or config.tpu.dcn_parallelism != 1:
             os.environ.setdefault(
                 "TM_TPU_ICI_PARALLELISM", str(config.tpu.ici_parallelism)
@@ -216,6 +219,17 @@ class Node(Service):
                 os.environ.setdefault(
                     "TM_TPU_MESH_BACKEND", config.tpu.mesh_backend
                 )
+        if config.scheduler.mesh_enable:
+            os.environ.setdefault("TM_TPU_ICI_PARALLELISM", "0")
+            if config.tpu.mesh_backend:
+                os.environ.setdefault(
+                    "TM_TPU_MESH_BACKEND", config.tpu.mesh_backend
+                )
+        # mesh_min_rows governs the sharded/replicated split of every
+        # mesh verifier in the process (latency floor for tiny rounds)
+        os.environ.setdefault(
+            "TM_TPU_MESH_MIN_ROWS", str(config.scheduler.mesh_min_rows)
+        )
         self.bls_key = bls.load_or_gen_bls_key(config.bls_key_file)
         self.bls_signer = bls.signer_for(
             bls.priv_key_from_bytes(self.bls_key.priv_key)
@@ -658,6 +672,19 @@ class Node(Service):
                     manifest = {
                         "created_unix": int(_time.time()),
                         "ladder": list(default_shape_registry().ladder),
+                        # the mesh topology the ladder was loaded for:
+                        # tools/prewarm.py --verify fails loudly when a
+                        # restarted node's live mesh disagrees (a wrong
+                        # topology would recompile on the hot path)
+                        "device_count": getattr(
+                            verifier, "mesh_devices", 1
+                        ),
+                        "mesh_min_rows": getattr(
+                            verifier, "_mesh_min_rows", 0
+                        ),
+                        "mesh_backend": os.environ.get(
+                            "TM_TPU_MESH_BACKEND", ""
+                        ),
                         "entries": entries,
                     }
                     path = self.config.path(

@@ -24,7 +24,15 @@ they happened to hold. This scheduler replaces those private paths:
   dispatch thread, batch N+1 is assembled, padded and sign-bytes
   challenge-hashed on the prep thread (`BatchVerifier.prepare` /
   `_PreparedBatch.run` split) — the host no longer idles through each
-  ~110 ms device round.
+  ~110 ms device round;
+- **mesh-sharded rounds**: when the verifier carries a device mesh
+  ([scheduler] mesh_enable / [tpu] axes), a coalesced round of at
+  least `mesh_min_rows` rows is padded to a bucket divisible by the
+  device count and row-sharded across every chip as ONE dispatch —
+  the round's verdict gather rides ICI, and the `scheduler.device_round`
+  span carries `sharded`/`devices` so the flight recorder attributes
+  multi-chip rounds. Small rounds stay effectively single-device for
+  latency (BatchVerifier.shards_for decides).
 
 Callers reach it through `default_dispatch(klass)`, which returns a
 classed adapter with the BatchVerifier.verify surface when a scheduler
@@ -184,6 +192,11 @@ class VerifyScheduler:
             1, thread_name_prefix="verify-dispatch"
         )
         self._accepting = True
+        # static topology gauge: how many devices the verify plane
+        # dispatches over (1 = meshless single-device)
+        self.metrics.mesh_devices.set(
+            getattr(self.verifier, "mesh_devices", 1)
+        )
         self._worker = self._loop.create_task(self._run())
 
     async def stop(self) -> None:
@@ -359,16 +372,19 @@ class VerifyScheduler:
                         continue  # landed between take and clear
                     await self._wakeup.wait()
                     continue
-                run = await self._host_prep(loop, round_)
-                if run is None:
+                prep = await self._host_prep(loop, round_)
+                if prep is None:
                     continue  # prep failed; futures already resolved
+                run, devices = prep
                 # serialize device rounds: round N completes (and its
                 # verdicts resolve) before round N+1 dispatches — while
                 # N executes, the loop above already prepped N+1
                 if inflight is not None:
                     await inflight
                     inflight = None
-                inflight = loop.create_task(self._execute(round_, run))
+                inflight = loop.create_task(
+                    self._execute(round_, run, devices)
+                )
         except asyncio.CancelledError:
             pass  # forced cancel (loop teardown): fall through to drain
         finally:
@@ -381,12 +397,13 @@ class VerifyScheduler:
 
     async def _host_prep(self, loop, round_):
         """Stage 1 of the pipeline: host-side batch assembly (padding,
-        sign-bytes challenge hashing) on the prep thread. Returns the
-        device-run callable, or None after resolving failures."""
+        sign-bytes challenge hashing) on the prep thread. Returns
+        (device-run callable, mesh device count of the dispatch), or
+        None after resolving failures."""
         kind = round_[0]
         if kind == "fn":
             sub = round_[1]
-            return lambda: sub.fn(sub.items)
+            return (lambda: sub.fn(sub.items)), 1
         _, slices, total = round_
         flat: list[SigItem] = []
         for sub, lo, take in slices:
@@ -395,7 +412,7 @@ class VerifyScheduler:
         if prep_fn is None:
             # plain .verify-only verifier (test stubs): no split, the
             # whole call runs on the dispatch thread
-            return lambda: self.verifier.verify(flat)
+            return (lambda: self.verifier.verify(flat)), 1
         t0 = time.perf_counter()
         try:
             prepared = await loop.run_in_executor(
@@ -411,9 +428,9 @@ class VerifyScheduler:
             time.perf_counter() - t0,
             n=total,
         )
-        return prepared.run
+        return prepared.run, getattr(prepared, "devices", 1)
 
-    async def _execute(self, round_, run) -> None:
+    async def _execute(self, round_, run, devices: int = 1) -> None:
         loop = asyncio.get_running_loop()
         kind = round_[0]
         tracer = default_tracer()
@@ -431,6 +448,8 @@ class VerifyScheduler:
             return
         dur = time.perf_counter() - t0
         self.metrics.dispatches.inc()
+        if devices > 1:
+            self.metrics.dispatch_sharded.inc()
         if kind == "fn":
             sub = round_[1]
             if not sub.future.done():
@@ -459,14 +478,15 @@ class VerifyScheduler:
         registry = getattr(
             self.verifier, "_registry", None
         ) or default_shape_registry()
-        bucket = registry.bucket_for(total)
+        bucket = registry.bucket_for(total, multiple_of=max(1, devices))
         fill = total / bucket if bucket else 0.0
         if n_subs >= 2:
             self.metrics.dispatch_coalesced.inc()
         self.metrics.batch_fill_ratio.set(round(fill, 4))
         self.dispatch_log.append(
             {"n": total, "subs": n_subs, "classes": classes,
-             "fill": round(fill, 4)}
+             "fill": round(fill, 4), "sharded": devices > 1,
+             "devices": devices}
         )
         tracer.add_span(
             "scheduler.queue_wait", oldest, t0 - oldest, n=total
@@ -475,6 +495,7 @@ class VerifyScheduler:
             "scheduler.device_round", t0, dur,
             n=total, bucket=bucket, fill=round(fill, 3),
             classes=",".join(classes), coalesced=n_subs,
+            sharded=devices > 1, devices=devices,
         )
 
     # --- failure paths -----------------------------------------------------

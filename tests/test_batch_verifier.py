@@ -239,7 +239,7 @@ def test_shape_budget_bounded_with_bit_identical_verdicts():
     assert reg.distinct_shapes("small") <= 8
     assert reg.buckets_by_tier()["small"] == (8, 32, 128)
     assert reg.shapes_by_tier()["small"] == (
-        (8, 128), (32, 128), (128, 128),
+        (8, 128, 1), (32, 128, 1), (128, 128, 1),
     )
     assert reg.dispatch_count() >= len(sizes)
     for tier, shapes in reg.shapes_by_tier().items():

@@ -404,6 +404,54 @@ def test_bench_degrades_to_structured_json_when_backend_unavailable(
     assert art["tunnel_down"] is True
 
 
+def test_bench_require_backend_fails_structured():
+    """--require-backend tpu on a CPU-only environment: non-zero exit,
+    structured {"rc","error","backend"} artifact with a meta block, NO
+    fallback row — the r04-r06 silent-CPU-capture regression class can
+    no longer produce a green bench run."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # probe succeeds, backend != tpu
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--require-backend", "tpu"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    art = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert art["rc"] == 1
+    assert art["backend"] == "cpu"
+    assert art["fallback"] == "none"
+    assert art["kind"] == "backend_mismatch"
+    assert art["required_backend"] == "tpu"
+    assert "meta" in art  # provenance stamp rides every artifact
+    # and the same contract on the multichip capture
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "tools/multichip_capture.py",
+            "4",
+            "--require-backend",
+            "tpu",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    art = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert art["ok"] is False and art["fallback"] == "none"
+    assert art["kind"] == "backend_mismatch"
+    assert "meta" in art  # provenance stamps the MULTICHIP family too
+
+
 # --- scenario e2e on a 4-validator mesh -------------------------------------
 
 

@@ -212,6 +212,54 @@ def test_node_mesh_from_config(tmp_path, monkeypatch):
         bv._default = old
 
 
+def test_node_mesh_enable_from_scheduler_config(tmp_path, monkeypatch):
+    """[scheduler] mesh_enable = true is the one-knob multi-chip path:
+    node assembly exports ici=0 (all local devices) + mesh_min_rows,
+    default_verifier() builds the mesh, the chain runs, and small
+    rounds still route single-device per mesh_min_rows."""
+    import asyncio
+
+    from tendermint_tpu.config import Config
+    from tendermint_tpu.crypto import batch_verifier as bv
+    from tendermint_tpu.node import Node, init_files
+
+    for var in (
+        "TM_TPU_ICI_PARALLELISM",
+        "TM_TPU_DCN_PARALLELISM",
+        "TM_TPU_MESH_BACKEND",
+        "TM_TPU_MESH_MIN_ROWS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("TM_TPU_MIN_DEVICE_BATCH", "0")
+    old = bv._default
+    bv._default = None
+    try:
+        cfg = Config.test_config()
+        cfg.root_dir = str(tmp_path)
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.scheduler.mesh_enable = True
+        cfg.scheduler.mesh_min_rows = 512
+        cfg.tpu.mesh_backend = "cpu"
+        init_files(cfg)
+        node = Node(cfg)
+
+        async def run():
+            await node.start()
+            await node.consensus.wait_for_height(2, timeout=120)
+            await node.stop()
+
+        asyncio.run(run())
+        v = bv.default_verifier()
+        assert v.mesh_devices == 8, "mesh_enable did not reach the verifier"
+        assert v._mesh_min_rows == 512
+        assert v.shards_for(16) == 1 and v.shards_for(512) == 8
+        out = np.asarray(v.verify(_sig_items(8, corrupt=(3,))))
+        assert (out == np.array([i != 3 for i in range(8)])).all()
+    finally:
+        bv._default = old
+
+
 def test_g1_aggregate_sharded_matches_host():
     """BLS G1 tree aggregation under the mesh == host point sum
     (VERDICT r4 missing #4: the non-ed25519 kernels had no sharded

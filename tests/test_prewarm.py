@@ -63,6 +63,86 @@ def test_bench_verify_family_shape_budget():
     assert reg.buckets_by_tier()["small"] == (8, 32, 128)
 
 
+def test_prewarm_manifest_devices_variants():
+    """Under a mesh the ladder prewarms per reachable device variant:
+    rungs whose batches can only arrive below mesh_min_rows load the
+    replicated (devices=1) program, rungs reachable at/above it also
+    load the sharded one; the manifest records the topology."""
+    from tools.prewarm import build_manifest, check_topology
+
+    manifest = build_manifest(
+        ladder=(8, 32),
+        tiers=("small",),
+        devices=4,
+        mesh_backend="cpu",
+        mesh_min_rows=16,
+    )
+    assert manifest["device_count"] == 4
+    assert manifest["mesh_min_rows"] == 16
+    shapes = {
+        (e["tier"], e["bucket"], e["devices"])
+        for e in manifest["entries"]
+    }
+    # rung 8: only n in 1..8 (< 16) lands there -> unsharded only;
+    # rung 32: n in 9..15 unsharded AND n in 16..32 sharded
+    assert shapes == {
+        ("small", 8, 1),
+        ("small", 32, 1),
+        ("small", 32, 4),
+    }
+    assert check_topology(manifest, 4) == []
+    assert check_topology(manifest, 8), "device-count drift must fail"
+    assert check_topology(manifest, 4, expected_min_rows=16) == []
+    assert check_topology(
+        manifest, 4, expected_min_rows=1024
+    ), "mesh_min_rows drift changes the reachable program set"
+
+
+def test_prewarm_verify_topology_mismatch(tmp_path):
+    """--verify against a manifest built for a larger mesh than the
+    live one exits non-zero BEFORE rebuilding anything — a node
+    warm-started on the wrong topology fails loudly."""
+    out = tmp_path / "m.json"
+    out.write_text(
+        json.dumps(
+            {
+                "created_unix": 0,
+                "ladder": [8],
+                "tiers": ["small"],
+                "device_count": 4,
+                "mesh_min_rows": 16,
+                "entries": [],
+            }
+        )
+    )
+    import os
+
+    env = {k: v for k, v in os.environ.items()}
+    env["JAX_PLATFORMS"] = "cpu"
+    # no forced host device count -> 1 live cpu device != 4
+    env["XLA_FLAGS"] = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    r = subprocess.run(
+        [
+            sys.executable,
+            "tools/prewarm.py",
+            "--out",
+            str(out),
+            "--verify",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "TOPOLOGY MISMATCH" in r.stdout
+
+
 def test_prewarm_cli_smoke(tmp_path):
     """tools/prewarm.py end-to-end: build then --verify on a tiny
     ladder, both rc=0, manifest on disk."""

@@ -284,7 +284,7 @@ def test_failed_partial_submission_drops_remainder():
 
 def test_shape_registry_rows_dimension():
     """A grown table store is a new program even at the same bucket:
-    the registry keys shapes on (bucket, rows)."""
+    the registry keys shapes on (bucket, rows, devices)."""
     from tendermint_tpu.crypto.shape_registry import ShapeRegistry
 
     reg = ShapeRegistry()
@@ -294,8 +294,22 @@ def test_shape_registry_rows_dimension():
     assert reg.record_dispatch("generic", 8) is True
     assert reg.distinct_shapes("small") == 2
     assert reg.buckets_by_tier()["small"] == (8,)
-    assert reg.shapes_by_tier()["small"] == ((8, 128), (8, 256))
+    assert reg.shapes_by_tier()["small"] == ((8, 128, 1), (8, 256, 1))
     assert reg.dispatch_count() == 4
+    # a sharded round is a distinct program even at the same bucket/rows
+    assert reg.record_dispatch("small", 8, rows=128, devices=4) is True
+    assert reg.record_dispatch("small", 8, rows=128, devices=4) is False
+    assert reg.distinct_shapes("small") == 3
+    assert reg.sharded_dispatch_count() == 2
+    snap = reg.snapshot()
+    assert snap["sharded_dispatch_count"] == 2
+    delta = ShapeRegistry.delta(
+        snap, (reg.record_dispatch("small", 8, rows=128, devices=4),
+               reg.snapshot())[1]
+    )
+    assert delta["sharded_dispatch_count"] == 1
+    assert delta["device_dispatch_count"] == 1
+    assert delta["distinct_program_shapes"] == 0
 
 
 def test_verifier_failure_resolves_futures_and_recovers():

@@ -1,21 +1,39 @@
-"""Multichip dryrun capture that ALWAYS emits one parseable JSON artifact.
+"""Multichip capture that ALWAYS emits one parseable JSON artifact.
 
-The round-4 MULTICHIP artifact was `{"rc": 124, "tail": "<traceback>"}` —
-the driver timed out waiting on a jax init that hung on a dead tunnel
-endpoint. This wrapper runs `__graft_entry__.dryrun_multichip(n)` (which
-already sandboxes the mesh body in a sanitized subprocess) and prints one
-structured line:
+Two stages, one artifact:
 
-    {"n_devices", "rc", "ok", "error", "backend", "fallback", "elapsed_s"}
+1. **dryrun** (`capture`) — `__graft_entry__.dryrun_multichip(n)`
+   compile-checks the sharded verification step in a sanitized
+   subprocess (the round-4 lesson: a dead tunnel endpoint must produce
+   a structured artifact, not an rc=124 traceback tail).
+2. **sharded throughput** (`sharded_capture`) — drives the SAME
+   dispatch path the node runs: SigItem batches submitted to a
+   `VerifyScheduler` over a `BatchVerifier` built on a
+   `parallel.build_mesh` mesh, measured per device count on the bulk
+   bucket. No ad-hoc pmap loop — MULTICHIP and BENCH numbers come from
+   the scheduler/verifier code path itself, so a scaling number here is
+   a scaling number in the node.
 
-exit code is always 0: infrastructure state lives IN the artifact, so the
-driver never has to scrape tracebacks again.
+The artifact line:
+
+    {"n_devices", "rc", "ok", "error", "backend", "fallback",
+     "elapsed_s", "meta": {backend, device_count, jax_version},
+     "series": [{"devices", "sigs_per_s", "sharded_dispatches"}...],
+     "scaling_vs_1chip": {...}}
+
+`--require-backend tpu` exits non-zero with a structured artifact (no
+fallback row) when the probed backend differs — same honesty contract
+as bench.py. Exit code is otherwise 0: infrastructure state lives IN
+the artifact, so the driver never has to scrape tracebacks.
 
 Usage: python tools/multichip_capture.py [n_devices]
+           [--bucket 16384] [--require-backend tpu]
+           [--mesh-backend cpu] [--mesh-min-rows N] [--no-dryrun]
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -23,10 +41,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tendermint_tpu.libs.jax_cache import set_compile_cache_env  # noqa: E402
+
+set_compile_cache_env()
+
 
 def capture(n_devices: int) -> dict:
-    """Run the sharded dryrun and build the artifact dict (no printing,
-    no exits — unit-testable)."""
+    """Run the sharded compile dryrun and build the artifact dict (no
+    printing, no exits — unit-testable)."""
     from tendermint_tpu.chaos.backend_guard import classify_failure
 
     t0 = time.perf_counter()
@@ -55,26 +77,157 @@ def capture(n_devices: int) -> dict:
             "fallback": "none",
             "kind": classify_failure(msg, rc),
             "elapsed_s": round(time.perf_counter() - t0, 1),
+            "meta": _meta(live=False),
         }
 
 
-def _cpu_fallback(n: int, first: dict) -> dict | None:
+def _make_items(n: int, n_unique: int = 128) -> list:
+    """n SigItems from n_unique distinct signers (realistic validator
+    set; rows repeat like a multi-height replay batch)."""
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.crypto.batch_verifier import SigItem
+
+    base = []
+    for i in range(min(n, n_unique)):
+        sk = ed25519.PrivKey.from_secret(b"multichip-%d" % i)
+        msg = b"multichip-vote-%d" % i
+        base.append(SigItem(sk.public_key().data, msg, sk.sign(msg)))
+    reps = (n + len(base) - 1) // len(base)
+    return (base * reps)[:n]
+
+
+def _measure_devices(
+    items: list,
+    devices: int,
+    bucket: int,
+    mesh_backend: str = "",
+    mesh_min_rows: int | None = None,
+    iters: int = 3,
+    depth: int = 4,
+) -> dict:
+    """Throughput of the scheduler's dispatch path on a `devices`-chip
+    mesh: warm the verify tables and the program, then best-of-iters
+    over `depth` pipelined scheduler rounds of the full bucket."""
+    import asyncio
+
+    import numpy as np
+
+    from tendermint_tpu.crypto.batch_verifier import BatchVerifier
+    from tendermint_tpu.crypto.shape_registry import ShapeRegistry
+    from tendermint_tpu.parallel import build_mesh
+    from tendermint_tpu.parallel.scheduler import VerifyScheduler
+
+    mesh = (
+        build_mesh(ici_parallelism=devices, mesh_backend=mesh_backend)
+        if devices > 1
+        else None
+    )
+    reg = ShapeRegistry()
+    verifier = BatchVerifier(
+        mesh=mesh,
+        min_device_batch=0,
+        shape_registry=reg,
+        mesh_min_rows=mesh_min_rows,
+    )
+    verifier.warm(
+        list({it.pubkey for it in items}), bulk=True
+    )  # table build outside the clock, like a running node
+
+    async def run() -> float:
+        sched = VerifyScheduler(verifier, max_batch=bucket)
+        await sched.start()
+        out = await sched.submit(items)  # warm: program compile/load
+        assert np.asarray(out).all(), "multichip warm batch failed"
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(
+                *(sched.submit(items) for _ in range(depth))
+            )
+            dt = time.perf_counter() - t0
+            for o in outs:
+                assert np.asarray(o).all(), "multichip batch failed"
+            best = min(best, dt / depth)
+        await sched.stop()
+        return best
+
+    dt = asyncio.run(run())
+    return {
+        "devices": devices,
+        "sigs_per_s": round(len(items) / dt, 1),
+        "ms_per_round": round(dt * 1e3, 1),
+        "sharded_dispatches": reg.sharded_dispatch_count(),
+        "sharded": verifier.shards_for(len(items)) > 1,
+    }
+
+
+def sharded_capture(
+    max_devices: int,
+    bucket: int = 16384,
+    mesh_backend: str = "",
+    mesh_min_rows: int | None = None,
+) -> dict:
+    """Measure the scheduler dispatch path at 1, 2, 4, ... devices up
+    to min(max_devices, visible). Returns {series, scaling_vs_1chip}."""
+    import jax
+
+    avail = len(jax.devices(mesh_backend or None))
+    counts = [1]
+    d = 2
+    while d <= min(max_devices, avail):
+        counts.append(d)
+        d *= 2
+    top = min(max_devices, avail)
+    if top > 1 and top not in counts:
+        counts.append(top)
+    items = _make_items(bucket)
+    series = [
+        _measure_devices(
+            items, d, bucket,
+            mesh_backend=mesh_backend, mesh_min_rows=mesh_min_rows,
+        )
+        for d in counts
+    ]
+    base = series[0]["sigs_per_s"] or 1.0
+    return {
+        "bucket": bucket,
+        "metric": "ed25519_vote_verify_throughput_multichip",
+        "unit": "sigs/s",
+        "series": series,
+        "scaling_vs_1chip": {
+            str(s["devices"]): round(s["sigs_per_s"] / base, 3)
+            for s in series
+            if s["devices"] > 1
+        },
+        "devices_visible": avail,
+    }
+
+
+def _cpu_fallback(n: int, first: dict, argv_tail: list[str]) -> dict | None:
     """Infrastructure outage (tunnel_down/timeout): retry the capture
     once in a child whose environment has the tunnel plugin site fully
-    scrubbed and JAX_PLATFORMS pinned to cpu — same fallback contract
-    as bench.py's `_degrade`. Returns the merged artifact or None."""
+    scrubbed, JAX_PLATFORMS pinned to cpu and the device count forced,
+    so the SHARDED path still runs — same fallback contract as
+    bench.py's `_degrade`, and the meta block marks the row cpu.
+    Returns the merged artifact or None."""
     import subprocess
 
     from tendermint_tpu.chaos.backend_guard import sanitized_env
 
     env = sanitized_env(platform="cpu")
     env["TM_TPU_MULTICHIP_CHILD"] = "1"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={max(n, 1)}"
+        ).strip()
     timeout_s = float(
         os.environ.get("TM_TPU_MULTICHIP_FALLBACK_TIMEOUT", "1800")
     )
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), str(n)],
+            [sys.executable, os.path.abspath(__file__), str(n)]
+            + argv_tail,
             capture_output=True,
             text=True,
             timeout=timeout_s,
@@ -105,20 +258,133 @@ def _cpu_fallback(n: int, first: dict) -> dict | None:
     return None
 
 
-def main() -> None:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    art = capture(n)
+def _meta(live: bool = True) -> dict:
+    from tendermint_tpu.chaos.backend_guard import meta_block
+
+    return meta_block(live=live)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="multichip sharded-dispatch capture"
+    )
+    ap.add_argument("n_devices", nargs="?", type=int, default=8)
+    ap.add_argument("--bucket", type=int, default=16384)
+    ap.add_argument(
+        "--require-backend",
+        default=os.environ.get("TM_TPU_BENCH_REQUIRE_BACKEND", ""),
+        help="fail (structured artifact, non-zero exit, no fallback) "
+        "unless the probed backend equals this platform",
+    )
+    ap.add_argument("--mesh-backend", default="")
+    ap.add_argument("--mesh-min-rows", type=int, default=0)
+    ap.add_argument(
+        "--no-dryrun",
+        action="store_true",
+        help="skip the sanitized compile dryrun stage",
+    )
+    args = ap.parse_args()
+    n = args.n_devices
+    argv_tail = ["--bucket", str(args.bucket)]
+    if args.mesh_min_rows:
+        argv_tail += ["--mesh-min-rows", str(args.mesh_min_rows)]
+
+    is_child = os.environ.get("TM_TPU_MULTICHIP_CHILD") == "1"
+    if args.require_backend and not is_child:
+        from tendermint_tpu.chaos.backend_guard import probe_backend
+
+        status = probe_backend()
+        got = status.backend if status.available else None
+        if got != args.require_backend:
+            print(
+                json.dumps(
+                    {
+                        "n_devices": n,
+                        "rc": 1,
+                        "ok": False,
+                        "error": (
+                            status.error
+                            if not status.available
+                            else f"probed backend {got!r} != required "
+                            f"{args.require_backend!r}"
+                        ),
+                        "backend": got,
+                        "kind": (
+                            status.kind
+                            if not status.available
+                            else "backend_mismatch"
+                        ),
+                        "fallback": "none",
+                        "required_backend": args.require_backend,
+                        "meta": _meta(live=False),
+                    }
+                )
+            )
+            return 1
+
+    t0 = time.perf_counter()
+    if args.no_dryrun:
+        art = {
+            "n_devices": n, "rc": 0, "ok": True, "error": "",
+            "backend": None, "fallback": "none", "elapsed_s": 0.0,
+        }
+    else:
+        art = capture(n)
+    if not art["ok"] and args.require_backend:
+        # the honesty contract: with --require-backend a late outage
+        # (probe passed, dispatch died) must NOT degrade to a CPU row —
+        # structured failure, non-zero exit, no fallback
+        art["required_backend"] = args.require_backend
+        print(json.dumps(art))
+        return 1
     if (
         not art["ok"]
         and art.get("kind") in ("tunnel_down", "timeout")
-        and os.environ.get("TM_TPU_MULTICHIP_CHILD") != "1"
+        and not is_child
     ):
-        merged = _cpu_fallback(n, art)
+        merged = _cpu_fallback(n, art, argv_tail)
         if merged is not None:
             print(json.dumps(merged))
-            return
+            return 0
+        print(json.dumps(art))
+        return 0
+    if not art["ok"]:
+        print(json.dumps(art))
+        return 0
+
+    # dryrun compiled: measure the real scheduler dispatch path
+    try:
+        art.update(
+            sharded_capture(
+                n,
+                bucket=args.bucket,
+                mesh_backend=args.mesh_backend,
+                mesh_min_rows=args.mesh_min_rows or None,
+            )
+        )
+        art["meta"] = _meta()
+        art["backend"] = art["meta"]["backend"]
+    except BaseException as e:  # noqa: BLE001 - artifact must always emit
+        from tendermint_tpu.chaos.backend_guard import classify_failure
+
+        msg = str(e)[-1200:]
+        art.update(
+            {
+                "rc": 1,
+                "ok": False,
+                "error": f"sharded capture failed: {msg}",
+                "kind": classify_failure(msg, 1),
+                "meta": _meta(live=False),
+            }
+        )
+    art["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    if not art["ok"] and args.require_backend:
+        art["required_backend"] = args.require_backend
+        print(json.dumps(art))
+        return 1
     print(json.dumps(art))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

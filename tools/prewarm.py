@@ -9,21 +9,34 @@ programs exist, and this tool loads them ahead of time so the
 persistent compile cache holds every shape a node dispatches —
 a restarted node then pays zero per-shape loads mid-height.
 
+Under a device mesh the ladder is AOT-loaded PER DEVICE VARIANT
+(PERF_ANALYSIS §13): each rung's replicated (devices=1) and/or
+row-sharded (devices=N) program, exactly the reachable set given
+mesh_min_rows. The manifest records the topology (`device_count`,
+`mesh_min_rows`) it was built for, and --verify fails loudly when the
+live mesh disagrees — a node warm-started on a different topology
+would otherwise recompile every sharded program on the hot path.
+
 Modes:
 
   python tools/prewarm.py                      # build the manifest
-  python tools/prewarm.py --verify             # re-run; report per-
-                                               # bucket load times and
-                                               # fail on budget breach
+  python tools/prewarm.py --devices 4          # build for a 4-device mesh
+  python tools/prewarm.py --verify             # re-run the manifest's
+                                               # ladder ON ITS TOPOLOGY;
+                                               # report per-bucket load
+                                               # times, fail on budget
+                                               # breach or device-count
+                                               # mismatch with the live
+                                               # mesh
 
-Build executes every (tier, bucket) verify program once with
+Build executes every (tier, bucket, devices) verify program once with
 verdict-inert padded lanes (BatchVerifier.prewarm_buckets — the same
 routine the node's warm thread runs under [scheduler] prewarm=true) and
-writes {created_unix, ladder, entries:[{tier,bucket,seconds}]} JSON.
-Verify re-executes the manifest's ladder in a warmed-cache process: any
-entry slower than --reload-threshold seconds means the persistent cache
-is NOT absorbing that shape (regression), and the distinct-shape count
-must stay within --budget per tier.
+writes {created_unix, ladder, device_count, entries:[{tier,bucket,rows,
+devices,seconds}]} JSON. Verify re-executes the manifest's ladder in a
+warmed-cache process: any entry slower than --reload-threshold seconds
+means the persistent cache is NOT absorbing that shape (regression),
+and the distinct-shape count must stay within --budget per tier.
 """
 
 from __future__ import annotations
@@ -43,12 +56,35 @@ set_compile_cache_env()
 DEFAULT_MANIFEST = "prewarm_manifest.json"
 
 
+def _build_mesh(devices: int, backend: str = ""):
+    """Mesh over `devices` chips of the backend (0 = all visible; 1 or
+    a 1-device backend = no mesh)."""
+    if devices == 1:
+        return None
+    from tendermint_tpu.parallel import build_mesh
+
+    return build_mesh(ici_parallelism=devices, mesh_backend=backend)
+
+
+def live_device_count(backend: str = "") -> int:
+    """Devices visible to the backend the node would mesh over."""
+    import jax
+
+    return len(jax.devices(backend or None))
+
+
 def build_manifest(
-    ladder=None, tiers=("small", "big", "generic")
+    ladder=None,
+    tiers=("small", "big", "generic"),
+    devices: int = 1,
+    mesh_backend: str = "",
+    mesh_min_rows: int | None = None,
 ) -> dict:
     """Run the ladder prewarm on a fresh verifier + registry; returns
     the manifest dict (entries carry per-program wall seconds — on a
-    cold cache that is compile+load, on a warm cache just load)."""
+    cold cache that is compile+load, on a warm cache just load).
+    `devices` > 1 (or 0 = all visible) builds the mesh verifier and
+    prewarms both program families."""
     from tendermint_tpu.crypto.batch_verifier import BatchVerifier
     from tendermint_tpu.crypto.shape_registry import (
         DEFAULT_BUCKET_LADDER,
@@ -57,13 +93,25 @@ def build_manifest(
 
     ladder = tuple(ladder) if ladder else DEFAULT_BUCKET_LADDER
     registry = ShapeRegistry(ladder)
-    verifier = BatchVerifier(min_device_batch=0, shape_registry=registry)
+    mesh = _build_mesh(devices, mesh_backend)
+    verifier = BatchVerifier(
+        mesh=mesh,
+        min_device_batch=0,
+        shape_registry=registry,
+        mesh_min_rows=mesh_min_rows,
+    )
     t0 = time.perf_counter()
     entries = verifier.prewarm_buckets(buckets=ladder, tiers=tiers)
     return {
         "created_unix": int(time.time()),
         "ladder": list(registry.ladder),
         "tiers": list(tiers),
+        "device_count": verifier.mesh_devices,
+        "mesh_min_rows": verifier._mesh_min_rows,
+        # the backend the mesh was built on: --verify must count live
+        # devices of (and rebuild against) the SAME backend, or the
+        # topology check compares apples to oranges
+        "mesh_backend": mesh_backend,
         "entries": entries,
         "total_seconds": round(time.perf_counter() - t0, 3),
         "shapes_by_tier": registry.shapes_by_tier(),
@@ -72,19 +120,54 @@ def build_manifest(
 
 def check_budget(manifest: dict, budget: int) -> list[str]:
     """Per-tier distinct-shape budget violations (empty = pass). A
-    program's shape is (bucket, rows): the cached tiers' programs vary
-    with the table-store row allocation too."""
+    program's shape is (bucket, rows, devices): the cached tiers'
+    programs vary with the table-store row allocation, and a mesh
+    verifier's sharded family doubles the bulk rungs."""
     problems = []
     by_tier: dict[str, set] = {}
     for e in manifest["entries"]:
         by_tier.setdefault(e["tier"], set()).add(
-            (e["bucket"], e.get("rows", 0))
+            (e["bucket"], e.get("rows", 0), e.get("devices", 1))
         )
     for tier, shapes in sorted(by_tier.items()):
         if len(shapes) > budget:
             problems.append(
                 f"tier {tier}: {len(shapes)} distinct shapes > budget "
                 f"{budget}: {sorted(shapes)}"
+            )
+    return problems
+
+
+def check_topology(
+    manifest: dict,
+    live_devices: int,
+    expected_min_rows: int | None = None,
+) -> list[str]:
+    """Mismatches between the manifest's mesh topology and the live
+    one (empty = pass). A manifest built for N devices prewarmed the
+    devices=N sharded programs; a node now meshing over M != N would
+    compile every sharded shape on the hot path — fail loudly
+    instead. `expected_min_rows` (the node's configured mesh_min_rows,
+    when known) must also match: it decides WHICH rungs got the
+    replicated vs sharded variant, so a drifted threshold silently
+    changes the reachable program set even at the same device count."""
+    problems = []
+    built = int(manifest.get("device_count", 1))
+    if built != live_devices:
+        problems.append(
+            f"manifest built for {built} device(s), live mesh has "
+            f"{live_devices} — sharded programs would recompile on the "
+            "hot path; rebuild the manifest on this topology"
+        )
+    if expected_min_rows is not None:
+        built_rows = manifest.get("mesh_min_rows")
+        if built_rows is not None and int(built_rows) != int(
+            expected_min_rows
+        ):
+            problems.append(
+                f"manifest built with mesh_min_rows={built_rows}, "
+                f"expected {expected_min_rows} — the replicated/sharded "
+                "variant split differs; rebuild the manifest"
             )
     return problems
 
@@ -105,6 +188,25 @@ def main() -> int:
         help="comma-separated tiers to prewarm",
     )
     ap.add_argument(
+        "--devices",
+        type=int,
+        default=1,
+        help="mesh device count to prewarm for (0 = all visible "
+        "devices of --mesh-backend; 1 = no mesh)",
+    )
+    ap.add_argument(
+        "--mesh-backend",
+        default="",
+        help="jax backend for the mesh ('' = default; 'cpu' = host "
+        "virtual devices)",
+    )
+    ap.add_argument(
+        "--mesh-min-rows",
+        type=int,
+        default=0,
+        help="rounds below this stay unsharded (0 = built-in default)",
+    )
+    ap.add_argument(
         "--budget",
         type=int,
         default=8,
@@ -113,7 +215,9 @@ def main() -> int:
     ap.add_argument(
         "--verify",
         action="store_true",
-        help="re-run an existing manifest's ladder and report load times",
+        help="re-run an existing manifest's ladder on its recorded "
+        "topology; fail on budget breach, slow reloads, or live "
+        "device-count mismatch",
     )
     ap.add_argument(
         "--reload-threshold",
@@ -130,7 +234,11 @@ def main() -> int:
         else None
     )
     tiers = tuple(t.strip() for t in args.tiers.split(",") if t.strip())
+    devices = args.devices
+    mesh_min_rows = args.mesh_min_rows or None
+    mesh_backend = args.mesh_backend
 
+    rc = 0
     if args.verify:
         if not os.path.exists(args.out):
             print(f"no manifest at {args.out}; run without --verify first")
@@ -139,19 +247,55 @@ def main() -> int:
             prior = json.load(f)
         ladder = ladder or tuple(prior["ladder"])
         tiers = tuple(prior.get("tiers", tiers))
+        devices = int(prior.get("device_count", 1))
+        # an explicit --mesh-min-rows is the node's configured value:
+        # check it against what the manifest was built with; otherwise
+        # re-run on the manifest's own threshold
+        expected_rows = mesh_min_rows
+        mesh_min_rows = prior.get("mesh_min_rows") or mesh_min_rows
+        # re-run on the manifest's recorded backend (CLI flag as the
+        # pre-mesh_backend-manifest fallback): the live device count and
+        # the rebuilt programs must come from the SAME backend the
+        # manifest was built on
+        mesh_backend = prior.get("mesh_backend", args.mesh_backend)
+        # topology check BEFORE the rebuild: the re-run must load the
+        # manifest's programs, and a mesh of a different size can't
+        live = live_device_count(mesh_backend) if devices != 1 else 1
+        for p in check_topology(
+            prior,
+            live if devices != 1 else devices,
+            expected_min_rows=expected_rows,
+        ):
+            print(f"TOPOLOGY MISMATCH: {p}")
+            rc = 1
+        if devices != 1 and live < devices:
+            # can't even construct the mesh; report and bail non-zero
+            return 1
+        if rc:
+            # a drifted threshold means the rebuild below would load a
+            # DIFFERENT program set than the manifest promises — the
+            # mismatch is the verdict
+            return rc
 
-    manifest = build_manifest(ladder=ladder, tiers=tiers)
+    manifest = build_manifest(
+        ladder=ladder,
+        tiers=tiers,
+        devices=devices,
+        mesh_backend=mesh_backend,
+        mesh_min_rows=mesh_min_rows,
+    )
     for e in manifest["entries"]:
         print(
             f"  {e['tier']:>8s}  bucket {e['bucket']:>6d}  "
-            f"rows {e.get('rows', 0):>5d}  {e['seconds']:7.2f}s"
+            f"rows {e.get('rows', 0):>5d}  "
+            f"devs {e.get('devices', 1):>3d}  {e['seconds']:7.2f}s"
         )
     print(
         f"{len(manifest['entries'])} programs, "
-        f"{manifest['total_seconds']:.1f}s total"
+        f"{manifest['total_seconds']:.1f}s total, "
+        f"{manifest['device_count']} device(s)"
     )
 
-    rc = 0
     problems = check_budget(manifest, args.budget)
     for p in problems:
         print(f"BUDGET VIOLATION: {p}")
@@ -165,12 +309,13 @@ def main() -> int:
         ]
         for e in slow:
             print(
-                f"RELOAD REGRESSION: {e['tier']}/{e['bucket']} took "
+                f"RELOAD REGRESSION: {e['tier']}/{e['bucket']}"
+                f"/devs{e.get('devices', 1)} took "
                 f"{e['seconds']:.1f}s > {args.reload_threshold:.0f}s — "
                 "persistent cache is not absorbing this shape"
             )
             rc = 1
-        if not slow and not problems:
+        if not slow and not problems and rc == 0:
             print("verify OK: every ladder program loads within threshold")
     else:
         with open(args.out, "w") as f:
