@@ -250,7 +250,23 @@ def main() -> None:
         "sanitized CPU fallback as a bench result; this flag makes a "
         "missing device a loud error instead of a quiet 0.14x row.",
     )
+    ap.add_argument(
+        "--family",
+        default="",
+        choices=("", "consensus_pacing"),
+        help="run ONE named bench family instead of the device "
+        "throughput suite. 'consensus_pacing' measures wall-per-height "
+        "static vs adaptive timeouts on the 4-validator harness — a "
+        "wall-clock family, valid on the CPU backend.",
+    )
     args = ap.parse_args()
+
+    if args.family == "consensus_pacing":
+        # wall-clock family: no device requirement, no backend probe —
+        # the verify path rides the host fast lane either way and both
+        # variants pay it identically
+        print(json.dumps(_bench_consensus_pacing()))
+        return
 
     # the CPU-fallback child already probed and pinned JAX_PLATFORMS=cpu;
     # re-probing there would recurse
@@ -436,6 +452,136 @@ def main() -> None:
             }
         )
     )
+
+
+def _bench_consensus_pacing(heights: int = 10, warm: int = 4) -> dict:
+    """consensus_pacing family: wall-per-height on the 4-validator
+    in-proc net, static reference-default timeouts vs adaptive pacing
+    ([consensus] adaptive_timeouts, consensus/pacing.py), with the
+    timeout-floor share of wall from the trace attribution
+    (obs.wall_attribution). Wall-clock family: the CPU backend measures
+    it faithfully (PERF_ANALYSIS §14) — vote verify cost is the same in
+    both variants and the DELTA is the floors.
+
+    Static config = the reference defaults (timeout_commit=1.0 s etc.,
+    skip_timeout_commit=false): exactly the floor a default-configured
+    committee pays per height regardless of how fast it actually
+    closes quorums. The adaptive variant learns the live arrival tail
+    and pays (tail * margin) instead, ceiling-clamped to those same
+    statics."""
+    import asyncio
+
+    from tendermint_tpu import obs
+    from tendermint_tpu.consensus.state_machine import ConsensusConfig
+    from tests.helpers import make_genesis, make_validators
+    from tests.test_consensus import make_node, wire_net
+
+    def run_variant(adaptive: bool) -> dict:
+        cfg = ConsensusConfig(
+            # reference defaults, straggler wait ON (the default)
+            timeout_propose=3.0,
+            timeout_propose_delta=0.5,
+            timeout_prevote=1.0,
+            timeout_prevote_delta=0.5,
+            timeout_precommit=1.0,
+            timeout_precommit_delta=0.5,
+            timeout_commit=1.0,
+            skip_timeout_commit=False,
+            adaptive_timeouts=adaptive,
+            # learn fast enough to converge inside the warmup heights
+            adaptive_window=64,
+            adaptive_min_samples=4,
+            adaptive_recover_step=0.25,
+            adaptive_tail_quantile=0.95,
+            adaptive_min_factor=0.02,
+        )
+        tracer = obs.Tracer(enabled=True, ring_size=65536)
+
+        async def run():
+            vs, pvs = make_validators(4)
+            genesis = make_genesis(vs)
+            nodes = [
+                make_node(
+                    vs,
+                    pv,
+                    genesis,
+                    config=cfg,
+                    # node 0 records; sharing one ring across nodes
+                    # would overlap their height windows in attribution
+                    tracer=(
+                        tracer if i == 0 else obs.Tracer(enabled=False)
+                    ),
+                )
+                for i, pv in enumerate(pvs)
+            ]
+            css = [n[0] for n in nodes]
+            wire_net(css)
+            for cs in css:
+                await cs.start()
+            await asyncio.gather(
+                *(cs.wait_for_height(warm, timeout=120) for cs in css)
+            )
+            tracer.clear()
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(
+                    cs.wait_for_height(warm + heights, timeout=600)
+                    for cs in css
+                )
+            )
+            wall = (time.perf_counter() - t0) / heights
+            snap = css[0].pacing.snapshot() if css[0].pacing else None
+            for cs in css:
+                await cs.stop()
+            return wall, snap
+
+        wall, snap = asyncio.run(run())
+        att = obs.wall_attribution(
+            [r.to_json() for r in tracer.records()]
+        )
+        return {
+            "wall_ms": round(wall * 1e3, 1),
+            "floor_share": (att["aggregate"] or {}).get("floor_share"),
+            "pacing": snap,
+        }
+
+    static = run_variant(False)
+    adaptive = run_variant(True)
+    commit_eff = None
+    if adaptive["pacing"]:
+        commit_eff = round(
+            adaptive["pacing"]["steps"]["commit"]["effective_s"] * 1e3, 1
+        )
+    return {
+        "metric": "consensus_pacing_wall_per_height",
+        "value": adaptive["wall_ms"],
+        "unit": (
+            f"ms/height adaptive (static {static['wall_ms']} ms at "
+            f"reference-default timeouts; 4 validators, in-proc, "
+            f"wall-clock)"
+        ),
+        "vs_baseline": round(
+            static["wall_ms"] / max(adaptive["wall_ms"], 0.01), 2
+        ),
+        "meta": _meta_block(),
+        "extra_metrics": [
+            {
+                "metric": "consensus_pacing_timeout_floor_share_static",
+                "value": static["floor_share"],
+                "unit": "fraction of wall in timeout-floor steps",
+            },
+            {
+                "metric": "consensus_pacing_timeout_floor_share_adaptive",
+                "value": adaptive["floor_share"],
+                "unit": "fraction of wall in timeout-floor steps",
+            },
+            {
+                "metric": "consensus_pacing_commit_wait_adaptive",
+                "value": commit_eff,
+                "unit": "ms effective commit wait (static 1000)",
+            },
+        ],
+    }
 
 
 def _quorum_lag_metrics(att) -> list:
@@ -663,20 +809,24 @@ def _bench_height_attribution():
             recs = [r.to_json() for r in tracer.records()]
             att = obs.attribution(recs)
             # per-height quorum-close lag (height_vote_set.py events):
-            # the committee-spread baseline BENCH artifacts track
-            from tendermint_tpu.obs.report import pct
+            # the committee-spread baseline BENCH artifacts track —
+            # through the SAME sketch the pacing controllers learn from
+            # (obs/quantile.py), so the bench percentile and the
+            # controller's view of the tail can never disagree
+            from tendermint_tpu.obs import StreamingQuantile
 
-            lags = [
+            sketch = StreamingQuantile(window=4096)
+            sketch.extend(
                 float((r.get("fields") or {}).get("lag_ms", 0.0))
                 for r in recs
                 if r.get("name") == "quorum.close"
                 and (r.get("fields") or {}).get("type") == "precommit"
-            ]
-            if lags:
+            )
+            if len(sketch):
                 att["quorum_close"] = {
-                    "count": len(lags),
-                    "p50_ms": round(pct(lags, 0.5), 3),
-                    "p95_ms": round(pct(lags, 0.95), 3),
+                    "count": sketch.count,
+                    "p50_ms": round(sketch.quantile(0.5), 3),
+                    "p95_ms": round(sketch.quantile(0.95), 3),
                 }
             return att
         finally:

@@ -152,6 +152,44 @@ class ConsensusTimeoutsConfig:
     # morph: the sequencer-mode switch height (upgrade/upgrade.go; flag
     # --consensus.switchHeight in the reference)
     switch_height: int = 0
+    # --- adaptive pacing (consensus/pacing.py) ----------------------------
+    # learn live arrival-tail distributions from the quorum-lag sensors
+    # and drive round-0 timeouts between adaptive_min_factor * static
+    # (floor of last resort) and the static timeout_* values (hard
+    # ceiling), with AIMD back-off on fired timeouts / rounds > 0
+    adaptive_timeouts: bool = False
+    adaptive_tail_quantile: float = 0.99
+    adaptive_safety_margin: float = 1.25
+    adaptive_headroom: float = 0.002
+    adaptive_min_factor: float = 0.05
+    adaptive_window: int = 256
+    adaptive_min_samples: int = 8
+    adaptive_backoff_step: float = 0.5
+    adaptive_recover_step: float = 0.1
+
+    # every timeout/adaptive knob to_state_machine_config() carries over;
+    # a field added to the state-machine ConsensusConfig MUST be listed
+    # here or config files silently lose it (round-trip test pins this)
+    _SM_FIELDS = (
+        "timeout_propose",
+        "timeout_propose_delta",
+        "timeout_prevote",
+        "timeout_prevote_delta",
+        "timeout_precommit",
+        "timeout_precommit_delta",
+        "timeout_commit",
+        "skip_timeout_commit",
+        "create_empty_blocks",
+        "adaptive_timeouts",
+        "adaptive_tail_quantile",
+        "adaptive_safety_margin",
+        "adaptive_headroom",
+        "adaptive_min_factor",
+        "adaptive_window",
+        "adaptive_min_samples",
+        "adaptive_backoff_step",
+        "adaptive_recover_step",
+    )
 
     def validate_basic(self) -> None:
         for f in (
@@ -162,21 +200,22 @@ class ConsensusTimeoutsConfig:
         ):
             if getattr(self, f) < 0:
                 raise ValueError(f"consensus.{f} cannot be negative")
+        if self.adaptive_timeouts:
+            # the controller's own validation, surfaced at config load
+            # instead of node assembly; from_knobs is the ONE mapping
+            # the controller constructor also uses, so the values
+            # validated here are the values the node will run
+            from ..consensus.pacing import PacingConfig
+
+            try:
+                PacingConfig.from_knobs(self).validate()
+            except ValueError as e:
+                raise ValueError(f"consensus.{e}") from e
 
     def to_state_machine_config(self):
         from ..consensus.state_machine import ConsensusConfig as SMC
 
-        return SMC(
-            timeout_propose=self.timeout_propose,
-            timeout_propose_delta=self.timeout_propose_delta,
-            timeout_prevote=self.timeout_prevote,
-            timeout_prevote_delta=self.timeout_prevote_delta,
-            timeout_precommit=self.timeout_precommit,
-            timeout_precommit_delta=self.timeout_precommit_delta,
-            timeout_commit=self.timeout_commit,
-            skip_timeout_commit=self.skip_timeout_commit,
-            create_empty_blocks=self.create_empty_blocks,
-        )
+        return SMC(**{f: getattr(self, f) for f in self._SM_FIELDS})
 
 
 @dataclass

@@ -33,6 +33,7 @@ class HeightVoteSet:
         val_set: ValidatorSet,
         tracer=None,
         metrics=None,
+        pacing=None,
     ):
         self.chain_id = chain_id
         self.height = height
@@ -40,11 +41,19 @@ class HeightVoteSet:
         self.round = 0
         self.tracer = default_tracer() if tracer is None else tracer
         self.metrics = metrics
+        # consensus/pacing.PacingController: arrival lags feed it
+        # SYNCHRONOUSLY on the accept path (not via metrics scrape) so
+        # the adaptive timeout controllers see every sample even with
+        # metrics/tracing off
+        self.pacing = pacing
         self._rounds: dict[int, dict[int, VoteSet]] = {}
         self._peer_catchup_rounds: dict[str, list[int]] = {}
         # (round, type) -> perf_counter of the first accepted vote; lag
         # attribution is relative to this
         self._first_arrival: dict[tuple[int, int], float] = {}
+        # (round, type) -> perf_counter of the 2/3-closing vote; votes
+        # accepted after this are the stragglers timeout_commit covers
+        self._quorum_closed_at: dict[tuple[int, int], float] = {}
         self.set_round(0)
 
     def set_round(self, round_: int) -> None:
@@ -106,16 +115,28 @@ class HeightVoteSet:
         self, vote: Vote, vs: VoteSet, had_quorum: bool, peer_id: str
     ) -> None:
         """Record arrival lag for an accepted vote and, when it flipped
-        the set to 2/3, the quorum-close attribution."""
+        the set to 2/3, the quorum-close attribution. Pacing samples are
+        fed regardless of metrics/tracer state — the controllers are a
+        control loop, not telemetry."""
         tracer = self.tracer
         metrics = self.metrics
-        if metrics is None and not tracer.enabled:
+        pacing = self.pacing
+        if pacing is None and metrics is None and not tracer.enabled:
             return
         now = time.perf_counter()
         key = (vote.round, vote.type)
         first = self._first_arrival.setdefault(key, now)
         lag = now - first
         tname = VOTE_TYPE_NAMES.get(vote.type, str(vote.type))
+        if pacing is not None:
+            if had_quorum:
+                closed_at = self._quorum_closed_at.get(key)
+                if closed_at is not None:
+                    pacing.observe_post_quorum_straggler(
+                        vote.type, now - closed_at
+                    )
+            else:
+                pacing.observe_vote_arrival(vote.type, lag)
         if metrics is not None:
             metrics.vote_arrival_lag.observe(lag, type=tname)
         if tracer.enabled:
@@ -131,6 +152,7 @@ class HeightVoteSet:
         if had_quorum or not vs.has_two_thirds_majority():
             return
         # this vote closed the 2/3 quorum
+        self._quorum_closed_at[key] = now
         if metrics is not None:
             metrics.quorum_close_lag.observe(lag, type=tname)
             metrics.quorum_closer.inc(
@@ -149,6 +171,15 @@ class HeightVoteSet:
                 peer=peer_id,
                 lag_ms=round(lag * 1e3, 3),
             )
+
+    def quorum_closed_at(
+        self, round_: int, vote_type: int
+    ) -> Optional[float]:
+        """perf_counter of the vote that closed this set's 2/3, or None.
+        The state machine stashes the commit round's value across the
+        height transition so straggler precommits arriving into
+        LastCommit still feed the pacing controller's commit sketch."""
+        return self._quorum_closed_at.get((round_, vote_type))
 
     def set_peer_maj23(
         self, round_: int, vote_type: int, peer_id: str, block_id

@@ -47,6 +47,12 @@ from ..types.vote_set import ConflictingVoteError, VoteSet
 from .batch import BatchCache, get_batch_start
 from .height_vote_set import HeightVoteSet
 from .messages import BlockPartMessage, ProposalMessage, VoteMessage
+from .pacing import (
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+    STEP_PROPOSE,
+    PacingController,
+)
 from .ticker import TimeoutInfo, TimeoutTicker
 from .wal import WAL, NilWAL, WALMessage, end_height_record
 
@@ -64,7 +70,14 @@ class Step(enum.IntEnum):
 
 @dataclass
 class ConsensusConfig:
-    """Timeouts (reference config/config.go:826-877 ConsensusConfig)."""
+    """Timeouts (reference config/config.go:826-877 ConsensusConfig).
+
+    The timeout_* values are the STATIC schedule. With adaptive_timeouts
+    on, a PacingController (consensus/pacing.py) learns the live
+    arrival-tail distributions and drives round-0 schedules dynamically
+    between `adaptive_min_factor * static` (floor of last resort) and
+    the static value (hard ceiling); rounds > 0 always run the static
+    per-round escalation."""
 
     timeout_propose: float = 3.0
     timeout_propose_delta: float = 0.5
@@ -75,6 +88,16 @@ class ConsensusConfig:
     timeout_commit: float = 1.0
     skip_timeout_commit: bool = False
     create_empty_blocks: bool = True
+    # --- adaptive pacing (consensus/pacing.py PacingConfig) ---------------
+    adaptive_timeouts: bool = False
+    adaptive_tail_quantile: float = 0.99
+    adaptive_safety_margin: float = 1.25
+    adaptive_headroom: float = 0.002
+    adaptive_min_factor: float = 0.05
+    adaptive_window: int = 256
+    adaptive_min_samples: int = 8
+    adaptive_backoff_step: float = 0.5
+    adaptive_recover_step: float = 0.1
 
     def propose(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_
@@ -97,6 +120,15 @@ class ConsensusConfig:
             timeout_commit=0.05,
             skip_timeout_commit=True,
         )
+
+
+# which fired timeouts are pacing failure signals, and which controller
+# each maps to (NEW_HEIGHT/NEW_ROUND fire on every healthy height)
+_PACING_TIMEOUT_STEPS = {
+    Step.PROPOSE: STEP_PROPOSE,
+    Step.PREVOTE_WAIT: STEP_PREVOTE,
+    Step.PRECOMMIT_WAIT: STEP_PRECOMMIT,
+}
 
 
 # event-switch event names (reactor fast path)
@@ -154,6 +186,7 @@ class ConsensusState:
         logger: Optional[Logger] = None,
         now_ns: Callable[[], int] = time.time_ns,
         commit_pipeline=None,
+        pacing=None,
     ):
         self.config = config
         self.executor = executor
@@ -176,6 +209,14 @@ class ConsensusState:
         self.tracer = default_tracer() if tracer is None else tracer
         self.logger = logger or nop_logger()
         self.now_ns = now_ns
+        # adaptive pacing: an explicit controller wins (node assembly
+        # injects one); otherwise self-construct from the config so the
+        # in-proc harnesses get it from `adaptive_timeouts` alone
+        if pacing is None and config.adaptive_timeouts:
+            pacing = PacingController.from_config(
+                config, metrics=self.metrics, tracer=self.tracer
+            )
+        self.pacing = pacing
         self._last_commit_walltime = 0.0
         # (step_name, t0, height, round) of the step in progress — the
         # flight recorder's per-step seam: each _new_step closes the
@@ -185,6 +226,16 @@ class ConsensusState:
         # the polka's height/round so a round that skipped prevote (e.g.
         # +2/3 precommits for a future round) can't observe a stale delay
         self._prevote_started: Optional[tuple[int, int, float]] = None
+        # (height, round, t0) of the last PROPOSE entry — the pacing
+        # controller's proposal-complete sample anchors here (and only
+        # when the complete proposal matches the same height/round)
+        self._propose_entered: Optional[tuple[int, int, float]] = None
+        # perf_counter of the previous height's precommit quorum close;
+        # LastCommit stragglers feed the pacing commit sketch against it
+        self._last_quorum_close_pc: Optional[float] = None
+        # validator indices whose too-late straggler precommit already
+        # fed the commit sketch this height (gossip re-delivers)
+        self._late_stragglers_fed: set[int] = set()
 
         self.event_switch = EventSwitch()
 
@@ -199,6 +250,10 @@ class ConsensusState:
         self.peer_msg_queue: asyncio.Queue = asyncio.Queue(1000)
         self.internal_msg_queue: asyncio.Queue = asyncio.Queue(1000)
         self.ticker = TimeoutTicker()
+        if self.pacing is not None:
+            # raw-expiry tally (staleness-unfiltered; the back-off
+            # decision itself sits behind _handle_timeout's filter)
+            self.ticker.set_on_fire(self._on_ticker_fired)
         self._receive_task: Optional[asyncio.Task] = None
         self._stopped = asyncio.Event()
         self._running = False
@@ -243,6 +298,10 @@ class ConsensusState:
             n = await catchup_replay(self, self.wal)
             if n:
                 self.logger.info("replayed WAL messages", count=n)
+                if self.pacing is not None:
+                    # replayed votes arrived at replay speed — their
+                    # near-zero lags are not the live committee's tail
+                    self.pacing.reset_learning()
         self._running = True
         self._receive_task = asyncio.get_running_loop().create_task(
             self._receive_routine(), name="consensus/receive"
@@ -412,6 +471,11 @@ class ConsensusState:
         else:
             self.logger.error("unknown msg type", msg=type(msg).__name__)
 
+    def _on_ticker_fired(self, ti: TimeoutInfo) -> None:
+        step = _PACING_TIMEOUT_STEPS.get(ti.step)
+        if step is not None and self.pacing is not None:
+            self.pacing.on_ticker_fired(step)
+
     async def _handle_timeout(self, ti: TimeoutInfo) -> None:
         rs = self.rs
         if (
@@ -420,6 +484,12 @@ class ConsensusState:
             or (ti.round == rs.round and ti.step < rs.step)
         ):
             return  # stale
+        if self.pacing is not None:
+            # a non-stale fired step timeout means the learned schedule
+            # did not cover the committee this round: AIMD back-off
+            step = _PACING_TIMEOUT_STEPS.get(ti.step)
+            if step is not None:
+                self.pacing.on_timeout_fired(step)
         if ti.step == Step.NEW_HEIGHT:
             await self._enter_new_round(ti.height, 0)
         elif ti.step == Step.NEW_ROUND:
@@ -483,6 +553,8 @@ class ConsensusState:
             self.tracer.event(
                 "cs.round_advance", height=height, round=round_
             )
+            if self.pacing is not None:
+                self.pacing.on_round_advance(round_)
         if self.metrics is not None:
             self.metrics.round_gauge.set(round_)
         rs.round = round_
@@ -524,9 +596,13 @@ class ConsensusState:
             return
         rs.step = Step.PROPOSE
         self._new_step()
-        self._schedule_timeout(
-            self.config.propose(round_), height, round_, Step.PROPOSE
+        self._propose_entered = (height, round_, time.perf_counter())
+        dur = (
+            self.pacing.propose(round_)
+            if self.pacing is not None
+            else self.config.propose(round_)
         )
+        self._schedule_timeout(dur, height, round_, Step.PROPOSE)
         if self._is_proposer(round_):
             await self._decide_proposal(height, round_)
         # if we already have a complete proposal (e.g. from a peer or a
@@ -710,6 +786,21 @@ class ConsensusState:
         rs = self.rs
         if rs.proposal_block is None:
             return
+        if self.pacing is not None:
+            # proposal-complete delay sample: only when the propose-step
+            # entry matches this height/round (parts that complete a
+            # proposal before we entered PROPOSE carry no wait signal)
+            # and we are not the proposer (our own proposal is local)
+            pe = self._propose_entered
+            if (
+                pe is not None
+                and pe[0] == height
+                and pe[1] == rs.round
+                and not self._is_proposer(rs.round)
+            ):
+                self.pacing.observe_proposal_complete(
+                    time.perf_counter() - pe[2]
+                )
         prevotes = rs.votes.prevotes(rs.round)
         bid, has_polka = (
             prevotes.two_thirds_majority() if prevotes else (None, False)
@@ -806,9 +897,12 @@ class ConsensusState:
             return
         rs.step = Step.PREVOTE_WAIT
         self._new_step()
-        self._schedule_timeout(
-            self.config.prevote(round_), height, round_, Step.PREVOTE_WAIT
+        dur = (
+            self.pacing.prevote(round_)
+            if self.pacing is not None
+            else self.config.prevote(round_)
         )
+        self._schedule_timeout(dur, height, round_, Step.PREVOTE_WAIT)
 
     # --- precommit --------------------------------------------------------
 
@@ -899,9 +993,12 @@ class ConsensusState:
             return
         rs.triggered_timeout_precommit = True
         self._new_step()
-        self._schedule_timeout(
-            self.config.precommit(round_), height, round_, Step.PRECOMMIT_WAIT
+        dur = (
+            self.pacing.precommit(round_)
+            if self.pacing is not None
+            else self.config.precommit(round_)
         )
+        self._schedule_timeout(dur, height, round_, Step.PRECOMMIT_WAIT)
 
     # --- commit -----------------------------------------------------------
 
@@ -1078,6 +1175,10 @@ class ConsensusState:
         """Commit telemetry, identical for both finalize paths (only the
         commit_seconds SCOPE differs: serial = full finalize, pipelined
         = the critical path up to this call)."""
+        if self.pacing is not None:
+            self.pacing.on_height_committed(
+                block.header.height, self.rs.round
+            )
         if self.metrics is not None:
             self.metrics.commit_seconds.observe(
                 time.perf_counter() - t_commit
@@ -1178,6 +1279,14 @@ class ConsensusState:
             pc = rs.votes.precommits(rs.commit_round)
             if pc is not None and pc.has_two_thirds_majority():
                 last_precommits = pc
+            # carry the commit round's quorum-close instant across the
+            # height transition: precommits that arrive AFTER this point
+            # land in LastCommit (the HVS below is fresh) but are still
+            # exactly the stragglers timeout_commit waits for
+            self._last_quorum_close_pc = rs.votes.quorum_closed_at(
+                rs.commit_round, VoteType.PRECOMMIT
+            )
+            self._late_stragglers_fed.clear()
         height = (
             state.initial_height
             if state.last_block_height == 0
@@ -1187,13 +1296,16 @@ class ConsensusState:
         rs.height = height
         rs.round = 0
         rs.step = Step.NEW_HEIGHT
-        # commit_time + timeout_commit (reference: wait for stragglers)
-        base = (
-            self.now_ns()
-            if state.last_block_height == 0
-            else self.now_ns()
-        )
-        rs.start_time_ns = base + int(self.config.timeout_commit * 1e9)
+        # commit_time + timeout_commit (reference: wait for stragglers).
+        # Adaptive pacing replaces the static straggler window with the
+        # learned post-quorum arrival tail (clamped to the static value
+        # as ceiling) — the dominant term of wall-per-height once the
+        # commit pipeline moved compute off the critical path (§12/§14)
+        base = self.now_ns()
+        commit_wait = self.config.timeout_commit
+        if self.pacing is not None and state.last_block_height > 0:
+            commit_wait = self.pacing.commit_wait()
+        rs.start_time_ns = base + int(commit_wait * 1e9)
         if self.config.skip_timeout_commit and last_precommits is not None:
             rs.start_time_ns = self.now_ns()
         rs.proposal = None
@@ -1211,6 +1323,7 @@ class ConsensusState:
             state.validators,
             tracer=self.tracer,
             metrics=self.metrics,
+            pacing=self.pacing,
         )
         rs.commit_round = -1
         rs.last_commit = last_precommits
@@ -1273,8 +1386,55 @@ class ConsensusState:
                 verified=pre_verified
                 or self._verify_vote(vote, self.state.last_validators),
             )
+            if (
+                added
+                and self.pacing is not None
+                and self._last_quorum_close_pc is not None
+            ):
+                self.pacing.observe_post_quorum_straggler(
+                    VoteType.PRECOMMIT,
+                    time.perf_counter() - self._last_quorum_close_pc,
+                )
             return added
         if vote.height != rs.height:
+            # previous-height precommits that arrive too late even for
+            # the LastCommit window are STILL commit-tail samples: the
+            # controller's output (the commit wait) must not censor its
+            # own input stream, or a tightened wait could never observe
+            # the widened tail of a degrading validator and would
+            # exclude it from LastCommit forever. Verified only — an
+            # unverifiable straggler must not inflate the learned wait.
+            if (
+                self.pacing is not None
+                and self._last_quorum_close_pc is not None
+                and vote.height + 1 == rs.height
+                and vote.type == VoteType.PRECOMMIT
+                # once per validator per height: gossip re-delivers, and
+                # a duplicate of a vote LastCommit already holds is not
+                # a missed straggler
+                and vote.validator_index not in self._late_stragglers_fed
+                and not (
+                    rs.last_commit is not None
+                    and 0 <= vote.validator_index < len(rs.last_commit.votes)
+                    and rs.last_commit.votes[vote.validator_index]
+                    is not None
+                )
+                and (
+                    pre_verified
+                    or self._verify_vote(vote, self.state.last_validators)
+                )
+            ):
+                self._late_stragglers_fed.add(vote.validator_index)
+                lag = time.perf_counter() - self._last_quorum_close_pc
+                self.pacing.observe_post_quorum_straggler(
+                    VoteType.PRECOMMIT, lag
+                )
+                self.tracer.event(
+                    "pacing.straggler_missed",
+                    height=vote.height,
+                    val=vote.validator_index,
+                    lag_ms=round(lag * 1e3, 3),
+                )
             return False
 
         if not pre_verified and not self._verify_vote(

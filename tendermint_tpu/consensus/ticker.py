@@ -23,7 +23,7 @@ class TimeoutInfo:
 
 
 class TimeoutTicker:
-    def __init__(self, scale: float = 1.0):
+    def __init__(self, scale: float = 1.0, on_fire=None):
         self._out: asyncio.Queue[TimeoutInfo] = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
         # clock skew: every scheduled duration is multiplied by this —
@@ -31,6 +31,11 @@ class TimeoutTicker:
         # <1 = eager) to model drifting local clocks without touching
         # the consensus state machine (chaos/scenario.py "clock_skew")
         self._scale = scale
+        # fired-timeout observer (adaptive pacing bookkeeping): called
+        # with the TimeoutInfo whenever a schedule actually EXPIRES —
+        # replaced/cancelled schedules never reach it, so the callback
+        # sees exactly the expiries the state machine will dequeue
+        self._on_fire = on_fire
 
     @property
     def tock_queue(self) -> asyncio.Queue:
@@ -40,6 +45,9 @@ class TimeoutTicker:
         if scale <= 0:
             raise ValueError("ticker scale must be positive")
         self._scale = scale
+
+    def set_on_fire(self, cb) -> None:
+        self._on_fire = cb
 
     def schedule(self, ti: TimeoutInfo) -> None:
         """Replaces any pending timeout (the reference stops the old timer
@@ -51,6 +59,11 @@ class TimeoutTicker:
     async def _fire(self, ti: TimeoutInfo) -> None:
         try:
             await asyncio.sleep(ti.duration_s * self._scale)
+            if self._on_fire is not None:
+                try:
+                    self._on_fire(ti)
+                except Exception:
+                    pass  # an observer must never kill the tick
             self._out.put_nowait(ti)
         except asyncio.CancelledError:
             pass

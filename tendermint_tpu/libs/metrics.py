@@ -461,6 +461,26 @@ class ConsensusMetrics:
             "Times a validator's vote closed the 2/3 quorum",
             ("validator", "type"),
         )
+        # --- adaptive pacing (consensus/pacing.py) ------------------------
+        self.adaptive_timeout = reg.gauge(
+            "consensus_adaptive_timeout_seconds",
+            "Per-step timeout schedule in effect (learned-or-backed-off "
+            "at round 0, the static escalation at rounds > 0); only "
+            "exported while adaptive pacing is enabled",
+            ("step",),
+        )
+        self.pacing_backoff = reg.gauge(
+            "consensus_pacing_backoff",
+            "AIMD back-off level per step: 0 = fully on the learned "
+            "arrival tail, 1 = static config schedule",
+            ("step",),
+        )
+        self.pacing_timeouts_fired = reg.counter(
+            "consensus_pacing_timeouts_fired_total",
+            "Non-stale step timeouts that actually expired (each one is "
+            "a pacing failure signal that backs the controller off)",
+            ("step",),
+        )
         self.proposal_gossip_seconds = reg.histogram(
             "consensus_proposal_gossip_seconds",
             "Proposer's proposal timestamp to our receipt, per sending "

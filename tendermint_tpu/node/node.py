@@ -437,8 +437,26 @@ class Node(Service):
                 tracer=self.tracer,
             )
         self.wal = wal
+        # adaptive pacing (consensus/pacing.py): the node owns the
+        # controller so the debug/RPC surface can snapshot it; the
+        # state machine would self-construct an identical one from the
+        # config, but explicit wiring keeps ownership visible alongside
+        # the commit pipeline and scheduler
+        sm_config = config.consensus.to_state_machine_config()
+        self.pacing = None
+        if config.consensus.adaptive_timeouts:
+            from ..consensus.pacing import PacingController
+
+            self.pacing = PacingController.from_config(
+                sm_config, metrics=consensus_metrics, tracer=self.tracer
+            )
+            self.logger.info(
+                "adaptive consensus pacing enabled",
+                tail_q=config.consensus.adaptive_tail_quantile,
+                min_factor=config.consensus.adaptive_min_factor,
+            )
         self.consensus = ConsensusState(
-            config.consensus.to_state_machine_config(),
+            sm_config,
             state,
             self.block_executor,
             self.block_store,
@@ -454,6 +472,7 @@ class Node(Service):
             tracer=self.tracer,
             logger=self.logger,
             commit_pipeline=self.commit_pipeline,
+            pacing=self.pacing,
         )
         self.consensus_reactor = ConsensusReactor(
             self.consensus, logger=self.logger

@@ -11,11 +11,14 @@ from .cluster import (
     normalize_dump,
     report_text,
 )
+from .quantile import StreamingQuantile
 from .report import (
     ascii_timeline,
     attribution,
     attribution_table,
+    pacing_decisions,
     side_by_side_timeline,
+    wall_attribution,
 )
 from .tracer import (
     DEFAULT_RING_SIZE,
@@ -29,6 +32,7 @@ from .tracer import (
 __all__ = [
     "DEFAULT_RING_SIZE",
     "SpanRecord",
+    "StreamingQuantile",
     "Tracer",
     "ascii_timeline",
     "attribution",
@@ -40,7 +44,9 @@ __all__ = [
     "link_latencies",
     "merge_records",
     "normalize_dump",
+    "pacing_decisions",
     "report_text",
     "set_default_tracer",
     "side_by_side_timeline",
+    "wall_attribution",
 ]

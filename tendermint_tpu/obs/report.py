@@ -71,6 +71,100 @@ def attribution(records: list[dict]) -> dict:
     }
 
 
+# wall-per-height attribution buckets (tools/pacing_report.py + the
+# consensus_pacing bench family). The cs.* step spans partition a
+# height's wall clock by construction (each closes at the transition to
+# the next), so bucketing THEM — not the nested exec/store spans, which
+# would double-count — splits wall time into:
+#   floor   — steps that exist to wait out a timeout window
+#   gossip  — steps spent waiting on peers (proposal parts, votes)
+#   compute — the decision/finalize step itself
+WALL_FLOOR_SPANS = frozenset(
+    {"cs.new_height", "cs.prevote_wait", "cs.precommit_wait"}
+)
+WALL_GOSSIP_SPANS = frozenset({"cs.propose", "cs.prevote", "cs.precommit"})
+WALL_COMPUTE_SPANS = frozenset({"cs.commit", "cs.new_round"})
+
+
+def wall_attribution(records: list[dict], n_heights: int = 64) -> dict:
+    """Per-height wall-clock attribution: how much of each height went
+    to the timeout floor vs gossip waits vs compute, from one node's
+    trace records (SpanRecord.to_json dicts). `other` is the residue of
+    the height window not covered by step spans (ring-boundary effects,
+    records from other subsystems widening the window)."""
+    recs = [SpanRecord.from_json(r) for r in records]
+    flight = flight_snapshot(recs, n_heights)
+    heights: dict[int, dict] = {}
+    for h, rows in flight.items():
+        t0 = min(r["t0"] for r in rows)
+        t1 = max(r["t0"] + r.get("dur", 0.0) for r in rows)
+        wall = t1 - t0
+        buckets = {"floor": 0.0, "gossip": 0.0, "compute": 0.0}
+        for r in rows:
+            if r["kind"] != "span":
+                continue
+            name = r["name"]
+            if name in WALL_FLOOR_SPANS:
+                buckets["floor"] += r.get("dur", 0.0)
+            elif name in WALL_GOSSIP_SPANS:
+                buckets["gossip"] += r.get("dur", 0.0)
+            elif name in WALL_COMPUTE_SPANS:
+                buckets["compute"] += r.get("dur", 0.0)
+        covered = sum(buckets.values())
+        heights[h] = {
+            "wall_ms": round(wall * 1e3, 3),
+            "floor_ms": round(buckets["floor"] * 1e3, 3),
+            "gossip_ms": round(buckets["gossip"] * 1e3, 3),
+            "compute_ms": round(buckets["compute"] * 1e3, 3),
+            "other_ms": round(max(0.0, wall - covered) * 1e3, 3),
+        }
+    if not heights:
+        return {"heights": {}, "aggregate": {}}
+    walls = [v["wall_ms"] for v in heights.values()]
+    floor = sum(v["floor_ms"] for v in heights.values())
+    gossip = sum(v["gossip_ms"] for v in heights.values())
+    compute = sum(v["compute_ms"] for v in heights.values())
+    total = sum(walls)
+    return {
+        "heights": heights,
+        "aggregate": {
+            "n_heights": len(heights),
+            "wall_ms_p50": round(pct(walls, 0.5), 3),
+            "wall_ms_p95": round(pct(walls, 0.95), 3),
+            "wall_ms_max": round(max(walls), 3),
+            "floor_share": round(floor / total, 4) if total else 0.0,
+            "gossip_share": round(gossip / total, 4) if total else 0.0,
+            "compute_share": round(compute / total, 4) if total else 0.0,
+        },
+    }
+
+
+def pacing_decisions(records: list[dict]) -> dict:
+    """Per-step learned-vs-static summary from `pacing.decision` trace
+    events (consensus/pacing.py emits one per step per height)."""
+    by_step: dict[str, list[dict]] = {}
+    for r in records:
+        if r.get("name") != "pacing.decision":
+            continue
+        f = r.get("fields") or {}
+        step = f.get("step")
+        if step:
+            by_step.setdefault(step, []).append(f)
+    out = {}
+    for step, rows in by_step.items():
+        eff = [float(x.get("effective_ms", 0.0)) for x in rows]
+        learned = [float(x.get("learned_ms", 0.0)) for x in rows]
+        out[step] = {
+            "decisions": len(rows),
+            "static_ms": float(rows[-1].get("static_ms", 0.0)),
+            "learned_ms_last": learned[-1] if learned else 0.0,
+            "effective_ms_p50": round(pct(eff, 0.5), 3),
+            "effective_ms_last": eff[-1] if eff else 0.0,
+            "backoff_last": float(rows[-1].get("backoff", 0.0)),
+        }
+    return out
+
+
 def ascii_timeline(records: list[dict], n_heights: int = 16) -> str:
     """Per-height step-timeline table. Spans show offset + duration from
     the height's first record; events render as `!` annotations at their

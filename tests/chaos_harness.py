@@ -51,6 +51,7 @@ def build_chaos_handles(
     tracer_factory=None,
     ping_interval: float = 10.0,
     powers=None,
+    config=None,
 ) -> list[NodeHandle]:
     """n validator NodeHandles (not yet listening/started).
 
@@ -59,7 +60,8 @@ def build_chaos_handles(
     None keeps every node on the process-wide tracer. A small
     `ping_interval` makes the peer clock-offset EWMAs converge inside a
     short run. `powers` gives per-validator voting powers (n_i holds the
-    key of validator index i in the sorted set)."""
+    key of validator index i in the sorted set). `config` overrides the
+    per-node ConsensusConfig (adaptive-pacing scenarios)."""
     if powers is not None:
         vs, pvs = make_weighted_validators(powers)
         n = len(powers)
@@ -69,7 +71,9 @@ def build_chaos_handles(
     handles: list[NodeHandle] = []
     for i, pv in enumerate(pvs):
         tracer = tracer_factory(f"n{i}") if tracer_factory else None
-        cs, app, l2, bs, ss = make_node(vs, pv, genesis, tracer=tracer)
+        cs, app, l2, bs, ss = make_node(
+            vs, pv, genesis, tracer=tracer, config=config
+        )
         nk = NodeKey.generate()
         transport, sw = _wire_node(cs, nk, ping_interval=ping_interval)
         handles.append(
