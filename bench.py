@@ -253,11 +253,19 @@ def main() -> None:
     ap.add_argument(
         "--family",
         default="",
-        choices=("", "consensus_pacing"),
+        choices=("", "consensus_pacing", "lightserve"),
         help="run ONE named bench family instead of the device "
         "throughput suite. 'consensus_pacing' measures wall-per-height "
-        "static vs adaptive timeouts on the 4-validator harness — a "
-        "wall-clock family, valid on the CPU backend.",
+        "static vs adaptive timeouts on the 4-validator harness; "
+        "'lightserve' drives an N-thousand light-client swarm through "
+        "the serving plane (tools/lightserve_bench.py). Both are "
+        "wall-clock families, valid on the CPU backend.",
+    )
+    ap.add_argument(
+        "--clients",
+        type=int,
+        default=1000,
+        help="lightserve family: simulated light clients in the swarm",
     )
     args = ap.parse_args()
 
@@ -266,6 +274,9 @@ def main() -> None:
         # the verify path rides the host fast lane either way and both
         # variants pay it identically
         print(json.dumps(_bench_consensus_pacing()))
+        return
+    if args.family == "lightserve":
+        print(json.dumps(_bench_lightserve(n_clients=args.clients)))
         return
 
     # the CPU-fallback child already probed and pinned JAX_PLATFORMS=cpu;
@@ -581,6 +592,82 @@ def _bench_consensus_pacing(heights: int = 10, warm: int = 4) -> dict:
                 "unit": "ms effective commit wait (static 1000)",
             },
         ],
+    }
+
+
+def _bench_lightserve(n_clients: int = 1000, heights: int = 8) -> dict:
+    """lightserve family: N simulated light clients sync a 4-validator
+    net through the serving plane (tendermint_tpu/lightserve via
+    tools/lightserve_bench.run_swarm). Wall-clock family, CPU-valid —
+    the point is the AMORTIZATION: cache hit-rate, verify dedup, and
+    device-dispatch counts sublinear in the client count, plus the
+    divergent-witness scenario landing LightClientAttackEvidence in
+    the evidence pool. vs_baseline is the dedup factor: verifications
+    the swarm REQUESTED over verifications actually executed (a
+    serverless swarm executes every one)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.lightserve_bench import run_swarm
+
+    stats = run_swarm(n_clients=n_clients, heights=heights)
+    verify = stats["verify"]
+    cache = stats["cache"]
+    scenarios = stats.get("scenarios", {})
+    dedup_factor = verify["requests"] / max(1, verify["executed"])
+    return {
+        "metric": "lightserve_swarm_sync",
+        "value": stats["clients_per_s"],
+        "unit": (
+            f"clients/s ({stats['synced']}/{stats['n_clients']} clients "
+            f"synced to height {stats['target_height']} of a "
+            f"{stats['n_validators']}-validator net, "
+            f"{stats['wall_s']}s wall)"
+        ),
+        "vs_baseline": round(dedup_factor, 1),
+        "meta": _meta_block(),
+        **stats["registry_delta"],
+        "extra_metrics": [
+            {
+                "metric": "lightserve_cache_hit_rate",
+                "value": cache["hit_rate"],
+                "unit": (
+                    f"fraction ({cache['hits']} hits / "
+                    f"{cache['misses']} misses, {cache['assembled']} "
+                    f"assemblies)"
+                ),
+            },
+            {
+                "metric": "lightserve_verify_dedup_rate",
+                "value": verify["dedup_rate"],
+                "unit": (
+                    f"fraction ({verify['requests']} requests -> "
+                    f"{verify['executed']} executed)"
+                ),
+            },
+            {
+                "metric": "lightserve_requests_per_device_dispatch",
+                "value": stats["requests_per_device_dispatch"],
+                "unit": (
+                    f"verify requests/device dispatch "
+                    f"({stats['registry_delta']['device_dispatch_count']}"
+                    f" dispatches, {stats['scheduler_rounds']} scheduler "
+                    f"rounds, for {stats['n_clients']} clients — "
+                    f"sublinearity of device work in swarm size)"
+                ),
+            },
+            {
+                "metric": "lightserve_attack_evidence_pool_size",
+                "value": (
+                    scenarios.get("divergent_witness", {}).get(
+                        "evidence_pool_size", 0
+                    )
+                ),
+                "unit": (
+                    "LightClientAttackEvidence accepted by the pool "
+                    "(divergent-witness scenario)"
+                ),
+            },
+        ],
+        "scenarios": scenarios,
     }
 
 

@@ -276,7 +276,7 @@ class SchedulerConfig:
     one process-wide service coalescing every subsystem's signature
     verification into shape-bucketed, priority-classed, pipelined
     device dispatches. Priority classes are fixed:
-    consensus > evidence > blocksync > light."""
+    consensus > evidence > blocksync > light > lightserve."""
 
     enable: bool = True
     # max signature items coalesced into one device round (the measured
@@ -363,6 +363,29 @@ class CommitPipelineConfig:
 
 
 @dataclass
+class LightServeConfig:
+    """Light-client serving plane (tendermint_tpu/lightserve): cached
+    `light_block`/`signed_header`/`validator_set` proof routes over the
+    node's stores plus the shared-round ServeVerifier that dedupes and
+    coalesces concurrent client bisection verifies under the scheduler's
+    `lightserve` lane."""
+
+    enable: bool = True
+    # LRU capacity of the LightBlockCache (one assembled proof per
+    # height; entries admit only below the durable store height)
+    cache_size: int = 1024
+    # seconds a completed hop verdict is reusable for clients whose
+    # `now` lands within the window; 0 = dedupe in-flight requests only
+    dedup_window: float = 60.0
+
+    def validate_basic(self) -> None:
+        if self.cache_size < 1:
+            raise ValueError("lightserve.cache_size must be >= 1")
+        if self.dedup_window < 0:
+            raise ValueError("lightserve.dedup_window cannot be negative")
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # kv | null
 
@@ -401,6 +424,7 @@ _SECTIONS = {
     "tpu": TpuConfig,
     "scheduler": SchedulerConfig,
     "commit_pipeline": CommitPipelineConfig,
+    "lightserve": LightServeConfig,
     "tx_index": TxIndexConfig,
     "instrumentation": InstrumentationConfig,
 }
@@ -423,6 +447,7 @@ class Config:
     commit_pipeline: CommitPipelineConfig = field(
         default_factory=CommitPipelineConfig
     )
+    lightserve: LightServeConfig = field(default_factory=LightServeConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig

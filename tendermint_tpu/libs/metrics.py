@@ -638,6 +638,49 @@ class SchedulerMetrics:
         )
 
 
+class LightServeMetrics:
+    """tendermint_tpu/lightserve — the light-client serving plane's
+    proof-cache and shared-verify health (hit rate and dedup rate are
+    the two numbers that say whether a thousand clients cost a thousand
+    assemblies/verifies or a handful)."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.cache_hits = reg.counter(
+            "lightserve_cache_hits_total",
+            "Light-block proof-cache hits",
+        )
+        self.cache_misses = reg.counter(
+            "lightserve_cache_misses_total",
+            "Light-block proof-cache misses (fresh assembly)",
+        )
+        self.cache_size = reg.gauge(
+            "lightserve_cache_size", "Cached light-block proofs"
+        )
+        self.cache_assemble_seconds = reg.histogram(
+            "lightserve_cache_assemble_seconds",
+            "LightBlock assembly from the block/state stores",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     float("inf")),
+        )
+        self.verify_requests = reg.counter(
+            "lightserve_verify_requests_total",
+            "Client verification requests into the serve verifier",
+            ("kind",),
+        )
+        self.verify_deduped = reg.counter(
+            "lightserve_verify_deduped_total",
+            "Requests that rode an in-flight or recent identical "
+            "verification instead of running their own",
+            ("kind",),
+        )
+        self.verify_executed = reg.counter(
+            "lightserve_verify_executed_total",
+            "Distinct verifications actually executed",
+            ("kind",),
+        )
+
+
 class EvidenceMetrics:
     def __init__(self, reg: Optional[Registry] = None):
         reg = reg or default_registry()

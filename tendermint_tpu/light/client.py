@@ -32,7 +32,6 @@ from .verifier import (
     ErrNewHeaderTooFarAhead,
     VerificationError,
     verify as _verify,
-    verify_adjacent,
     verify_non_adjacent,
 )
 
@@ -84,6 +83,7 @@ class LightClient:
         sequential: bool = False,
         pruning_size: int = DEFAULT_PRUNING_SIZE,
         now_ns=None,
+        serve_verifier=None,
         logger: Optional[Logger] = None,
     ):
         self.chain_id = chain_id
@@ -91,6 +91,12 @@ class LightClient:
         self.witnesses = list(witnesses)
         self.store = store
         self.trust_options = trust_options
+        # server-assisted mode (tendermint_tpu/lightserve): hop and
+        # trust-root verifications are delegated to a shared
+        # ServeVerifier so identical verifications across a client swarm
+        # dedupe and coalesce into shared device rounds; None keeps the
+        # self-verifying path
+        self.serve_verifier = serve_verifier
         self.trusting_period_ns = trusting_period_ns or (
             trust_options.period_ns if trust_options else 0
         )
@@ -113,6 +119,30 @@ class LightClient:
         return await asyncio.get_running_loop().run_in_executor(
             None, functools.partial(fn, *args, **kwargs)
         )
+
+    async def _hop_verify(
+        self, trusted: LightBlock, untrusted: LightBlock, now: int
+    ) -> None:
+        """One trusted→untrusted verification hop: through the shared
+        serve verifier when server-assisted (deduped across the swarm),
+        else self-verified off-loop."""
+        if self.serve_verifier is not None:
+            await self.serve_verifier.verify_hop(
+                trusted,
+                untrusted,
+                self.trusting_period_ns,
+                now,
+                self.max_clock_drift_ns,
+            )
+        else:
+            await self._off_loop(
+                _verify,
+                trusted,
+                untrusted,
+                self.trusting_period_ns,
+                now,
+                self.max_clock_drift_ns,
+            )
 
     # --- initialization (reference :267-402) --------------------------------
 
@@ -152,9 +182,12 @@ class LightClient:
             )
         lb.validate_basic(self.chain_id)
         # 2/3 of its own validator set must have signed (reference :369)
-        from .verifier import _verify_commit_full_power
+        if self.serve_verifier is not None:
+            await self.serve_verifier.verify_root(lb, now_ns=self.now_ns())
+        else:
+            from .verifier import _verify_commit_full_power
 
-        await self._off_loop(_verify_commit_full_power, lb)
+            await self._off_loop(_verify_commit_full_power, lb)
         # cross-check the root with all witnesses (reference :1131)
         await self._compare_with_witnesses(lb)
         self.store.save(lb)
@@ -187,8 +220,13 @@ class LightClient:
         # pre-build the verify tables for both endpoint sets in an
         # executor thread before the bisection loop: every step is two
         # >=set-size commit verifies, and the big-tier fixed-window build
-        # must not run inline in the first one (VERDICT r2 weak #3)
-        await self._warm_sets(trusted, new_block)
+        # must not run inline in the first one (VERDICT r2 weak #3).
+        # Server-assisted clients skip it — their verification runs on
+        # the serving plane's already-warm verifier, and a thousand
+        # swarm clients each warming a private table set would serialize
+        # the swarm behind one bulk build
+        if self.serve_verifier is None:
+            await self._warm_sets(trusted, new_block)
         if self.sequential:
             trace = await self._verify_sequential(trusted, new_block, now)
         else:
@@ -226,24 +264,26 @@ class LightClient:
         verified = trusted
         for h in range(trusted.height + 1, new_block.height):
             interim = await self._block_from_primary(h)
-            await self._off_loop(
-                verify_adjacent,
-                verified,
-                interim,
-                self.trusting_period_ns,
-                now,
-                self.max_clock_drift_ns,
-            )
+            # adjacent hops ride _hop_verify too (verify() dispatches on
+            # adjacency), so a sequential swarm dedupes like a skipping
+            # one — but sequential mode's guarantee IS adjacency:
+            # a primary answering the wrong height must fail outright,
+            # never silently downgrade to 1/3-trust skipping verification
+            if interim.height != verified.height + 1:
+                raise VerificationError(
+                    f"sequential verification: primary returned height "
+                    f"{interim.height}, want {verified.height + 1}"
+                )
+            await self._hop_verify(verified, interim, now)
             verified = interim
             trace.append(interim)
-        await self._off_loop(
-            verify_adjacent,
-            verified,
-            new_block,
-            self.trusting_period_ns,
-            now,
-            self.max_clock_drift_ns,
-        )
+        if new_block.height != verified.height + 1:
+            raise VerificationError(
+                f"sequential verification: target height "
+                f"{new_block.height} is not adjacent to "
+                f"{verified.height}"
+            )
+        await self._hop_verify(verified, new_block, now)
         trace.append(new_block)
         return trace
 
@@ -258,14 +298,7 @@ class LightClient:
         trace = [trusted]
         while True:
             try:
-                await self._off_loop(
-                    _verify,
-                    verified,
-                    block_cache[depth],
-                    self.trusting_period_ns,
-                    now,
-                    self.max_clock_drift_ns,
-                )
+                await self._hop_verify(verified, block_cache[depth], now)
             except ErrNewHeaderTooFarAhead:
                 # bisect: fetch the midpoint block
                 if depth == len(block_cache) - 1:
@@ -329,7 +362,7 @@ class LightClient:
             return_exceptions=True,
         )
         header_matched = False
-        to_remove = []
+        conflicting: list[tuple[int, LightBlock]] = []
         for i, res in enumerate(results):
             if isinstance(res, BaseException) or res is None:
                 # benign: witness unavailable / doesn't have the block
@@ -337,13 +370,36 @@ class LightClient:
             if res.header.hash() == last.header.hash():
                 header_matched = True
                 continue
-            # conflicting header: verify the witness's chain through the
-            # divergence point and build attack evidence
-            # (reference handleConflictingHeaders :217)
-            ev = await self._examine_conflict(primary_trace, res, i, now)
-            if ev is not None:
-                raise ErrLightClientAttack(ev)
-            to_remove.append(i)
+            conflicting.append((i, res))
+        # conflicting headers: verify each witness's chain through the
+        # divergence point and build attack evidence (reference
+        # handleConflictingHeaders :217). Examinations run concurrently
+        # — per-sync latency is bounded by the slowest conflicting
+        # witness, not the sum of all of them.
+        to_remove = []
+        if conflicting:
+            # return_exceptions: one examination blowing up on a
+            # non-verification failure (device/backend error) must not
+            # leave sibling examinations running unawaited — the failed
+            # witness is simply left in place (we couldn't judge it)
+            exams = await asyncio.gather(
+                *(
+                    self._examine_conflict(primary_trace, res, i, now)
+                    for i, res in conflicting
+                ),
+                return_exceptions=True,
+            )
+            for (i, _res), ev in zip(conflicting, exams):
+                if isinstance(ev, BaseException):
+                    self.logger.error(
+                        "witness conflict examination failed",
+                        witness=self.witnesses[i].id(),
+                        err=repr(ev),
+                    )
+                    continue
+                if ev is not None:
+                    raise ErrLightClientAttack(ev)
+                to_remove.append(i)
         for i in sorted(to_remove, reverse=True):
             self.logger.info(
                 "removing misbehaving witness", witness=self.witnesses[i].id()
@@ -368,8 +424,16 @@ class LightClient:
         witness = self.witnesses[witness_index]
         common: Optional[LightBlock] = None
         diverged: Optional[LightBlock] = None  # primary's first forked block
+        # early-stopping walk: the trace is bisection-short (O(log H))
+        # and an RPC provider serializes calls on one connection anyway,
+        # so eager whole-trace prefetch would only add round trips past
+        # the divergence point. Cross-witness concurrency lives one
+        # level up (_detect_divergence gathers the examinations).
         for lb in primary_trace:
-            w = await witness.light_block(lb.height)
+            try:
+                w = await witness.light_block(lb.height)
+            except Exception:
+                return None  # can't judge: treated like a missing block
             if w is None:
                 return None
             if w.header.hash() == lb.header.hash():

@@ -59,8 +59,11 @@ class LightStore:
             pass
 
     def prune(self, keep: int) -> None:
-        """Delete oldest blocks beyond `keep` (reference Prune)."""
-        excess = len(self._heights) - keep
+        """Delete oldest blocks beyond `keep` (reference Prune). The
+        latest trusted block is the client's verification anchor — a
+        mid-bisection prune (the client prunes per verified height)
+        must never evict it, so `keep` is clamped to >= 1."""
+        excess = len(self._heights) - max(1, keep)
         for h in list(self._heights[:max(0, excess)]):
             self.delete(h)
 

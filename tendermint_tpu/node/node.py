@@ -344,6 +344,23 @@ class Node(Service):
             make_kv("evidence"), self.state_store, self.block_store
         )
 
+        # --- light-client serving plane (tendermint_tpu/lightserve) ---
+        # cached light_block/signed_header/validator_set proof routes
+        # over the node's own stores + the shared-round ServeVerifier;
+        # rpc/core.py exposes the routes iff this exists
+        self.lightserve = None
+        if config.lightserve.enable:
+            from ..lightserve import LightServePlane
+
+            self.lightserve = LightServePlane(
+                self.block_store,
+                self.state_store,
+                self.genesis.chain_id,
+                cache_size=config.lightserve.cache_size,
+                dedup_window_ns=int(config.lightserve.dedup_window * 1e9),
+                logger=self.logger,
+            )
+
         # --- executor (node.go:883) ---
         self.block_executor = BlockExecutor(
             self.state_store,

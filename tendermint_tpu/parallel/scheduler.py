@@ -68,10 +68,12 @@ from ..obs import default_tracer
 
 # Priority classes, served strictly in this order when assembling a
 # round: live consensus votes must never queue behind a blocksync/light
-# backfill flood. Starvation the other way is structurally bounded —
-# every round takes whatever capacity consensus left (consensus load is
-# O(validators) per height, max_batch is 16k).
-CLASS_ORDER = ("consensus", "evidence", "blocksync", "light")
+# backfill flood, and serving EXTERNAL light clients (the lightserve
+# plane's shared bisection verifies) ranks below even the node's own
+# light-client work. Starvation the other way is structurally bounded —
+# every round takes whatever capacity the higher classes left
+# (consensus load is O(validators) per height, max_batch is 16k).
+CLASS_ORDER = ("consensus", "evidence", "blocksync", "light", "lightserve")
 
 DEFAULT_MAX_BATCH = 16384
 

@@ -80,6 +80,17 @@ class RPCCore:
             "validators": self.validators,
             "consensus_state": self.consensus_state,
             "dump_consensus_state": self.dump_consensus_state,
+            # light-client serving plane (tendermint_tpu/lightserve):
+            # cached proof routes, present when the node assembled one
+            **(
+                {
+                    "light_block": self.light_block,
+                    "signed_header": self.signed_header,
+                    "validator_set": self.validator_set,
+                }
+                if getattr(self.node, "lightserve", None) is not None
+                else {}
+            ),
             "dump_traces": self.dump_traces,
             "consensus_params": self.consensus_params,
             "tx": self.tx,
@@ -326,7 +337,53 @@ class RPCCore:
             "canonical": True,
         }
 
-    def validators(self, height=None, **_kw) -> dict:
+    # one page of validators per response (reference rpc/core/env.go
+    # validatePerPage: per_page defaults to 30, capped at 100) — large
+    # committees paginate instead of one unbounded response
+    _VALS_PER_PAGE_DEFAULT = 100
+    _VALS_PER_PAGE_MAX = 100
+
+    def _paginate_validators(self, vals, h: int, page, per_page) -> dict:
+        from .server import RPCError
+
+        try:
+            page = int(page) if page is not None else 1
+            per_page = (
+                int(per_page)
+                if per_page is not None
+                else self._VALS_PER_PAGE_DEFAULT
+            )
+        except (TypeError, ValueError):
+            raise RPCError(-32602, "invalid page/per_page") from None
+        per_page = max(1, min(per_page, self._VALS_PER_PAGE_MAX))
+        total = vals.size()
+        pages = max(1, -(-total // per_page))
+        if not (1 <= page <= pages):
+            raise RPCError(
+                -32602, f"page {page} out of range (1..{pages})"
+            )
+        lo = (page - 1) * per_page
+        window = vals.validators[lo : lo + per_page]
+        return {
+            "block_height": h,
+            "validators": [self._validator_json(v) for v in window],
+            "count": len(window),
+            "total": total,
+            "page": page,
+            "per_page": per_page,
+        }
+
+    @staticmethod
+    def _validator_json(v) -> dict:
+        return {
+            "address": _hex(v.address),
+            "pub_key": _hex(v.pub_key.data),
+            "pub_key_type": getattr(v.pub_key, "type_name", "ed25519"),
+            "voting_power": v.voting_power,
+            "proposer_priority": v.proposer_priority,
+        }
+
+    def validators(self, height=None, page=None, per_page=None, **_kw) -> dict:
         ss = self.node.state_store
         h = int(height) if height else self.node.block_store.height
         vals = ss.load_validators(h)
@@ -334,23 +391,63 @@ class RPCCore:
             from .server import RPCError
 
             raise RPCError(-32000, f"no validators at height {h}")
+        return self._paginate_validators(vals, h, page, per_page)
+
+    # --- light-client serving plane (tendermint_tpu/lightserve) -------------
+
+    def _lightserve_block(self, height):
+        from .server import RPCError
+
+        h = int(height) if height else 0
+        lb = self.node.lightserve.cache.get(h)
+        if lb is None:
+            raise RPCError(
+                -32000, f"no light block at height {h or 'latest'}"
+            )
+        return lb
+
+    def _signed_header_json(self, lb) -> dict:
         return {
-            "block_height": h,
-            "validators": [
-                {
-                    "address": _hex(v.address),
-                    "pub_key": _hex(v.pub_key.data),
-                    "pub_key_type": getattr(
-                        v.pub_key, "type_name", "ed25519"
-                    ),
-                    "voting_power": v.voting_power,
-                    "proposer_priority": v.proposer_priority,
-                }
-                for v in vals.validators
-            ],
-            "count": vals.size(),
-            "total": vals.size(),
+            "header": self._header_json(lb.header),
+            "commit": self._commit_json(lb.commit),
         }
+
+    def light_block(self, height=None, **_kw) -> dict:
+        """The full proof for one height — signed header + validator set
+        assembled once by the LightBlockCache and served to every
+        client (one round trip instead of commit + validators)."""
+        lb = self._lightserve_block(height)
+        return {
+            "light_block": {
+                "signed_header": self._signed_header_json(lb),
+                # the FULL set, un-paginated: this IS the proof — a
+                # partial set could never re-hash to validators_hash
+                "validator_set": {
+                    "validators": [
+                        self._validator_json(v)
+                        for v in lb.validators.validators
+                    ],
+                    "total": lb.validators.size(),
+                },
+            }
+        }
+
+    def signed_header(self, height=None, **_kw) -> dict:
+        """Header + commit only (clients that track the set themselves)."""
+        lb = self._lightserve_block(height)
+        return {
+            "signed_header": self._signed_header_json(lb),
+            "canonical": True,
+        }
+
+    def validator_set(self, height=None, page=None, per_page=None,
+                      **_kw) -> dict:
+        """The validator set backing a light block, paginated — served
+        from the proof cache (the `validators` route reads the state
+        store per request instead)."""
+        lb = self._lightserve_block(height)
+        return self._paginate_validators(lb.validators, lb.height, page,
+                                         per_page)
 
     def consensus_state(self) -> dict:
         cs = self.node.consensus

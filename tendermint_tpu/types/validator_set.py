@@ -43,6 +43,7 @@ class ValidatorSet:
         )
         self.proposer: Optional[Validator] = None
         self._total_voting_power: Optional[int] = None
+        self._hash: Optional[bytes] = None
         if self.validators:
             self._validate_unique()
             self.increment_proposer_priority(1)
@@ -91,10 +92,16 @@ class ValidatorSet:
 
     def hash(self) -> bytes:
         """Merkle root of validator encodings
-        (reference types/validator_set.go:351)."""
-        return merkle.hash_from_byte_slices(
-            [v.encode() for v in self.validators]
-        )
+        (reference types/validator_set.go:351). Memoized — the
+        encoding excludes proposer priority, so only membership/power
+        changes (update_with_change_set) invalidate; callers on the
+        serving hot path (lightserve verdict keys, per-vote header
+        checks) hash the same shared set per request."""
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [v.encode() for v in self.validators]
+            )
+        return self._hash
 
     def copy(self) -> "ValidatorSet":
         vs = ValidatorSet.__new__(ValidatorSet)
@@ -105,6 +112,7 @@ class ValidatorSet:
         else:
             vs.proposer = None
         vs._total_voting_power = self._total_voting_power
+        vs._hash = self._hash
         return vs
 
     # --- proposer priority (validator_set.go:105-246) ---------------------
@@ -213,6 +221,7 @@ class ValidatorSet:
 
         self.validators = sorted(updated.values(), key=lambda v: v.address)
         self._total_voting_power = None
+        self._hash = None  # membership/power changed
         if self.validators:
             self._rescale_priorities(
                 PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
@@ -463,6 +472,7 @@ class ValidatorSet:
         vs = cls.__new__(cls)
         vs.validators = sorted(vals, key=lambda v: v.address)
         vs._total_voting_power = None
+        vs._hash = None
         vs.proposer = None
         if 2 in f:
             i, v = vs.get_by_address(f[2][0])

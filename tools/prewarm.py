@@ -55,6 +55,20 @@ set_compile_cache_env()
 
 DEFAULT_MANIFEST = "prewarm_manifest.json"
 
+# scheduler verify class -> the verifier tiers its dispatches reach.
+# Every commit-verify class — including the lightserve serving plane's
+# shared bisection rounds, which coalesce arbitrary swarm sizes onto
+# the same ladder — runs the cached small/big tier split; a manifest
+# built without those tiers leaves that class compiling on the hot
+# path, so --verify checks coverage per family.
+FAMILY_TIERS = {
+    "consensus": ("small", "big"),
+    "evidence": ("small", "big"),
+    "blocksync": ("small", "big"),
+    "light": ("small", "big"),
+    "lightserve": ("small", "big"),
+}
+
 
 def _build_mesh(devices: int, backend: str = ""):
     """Mesh over `devices` chips of the backend (0 = all visible; 1 or
@@ -106,6 +120,15 @@ def build_manifest(
         "created_unix": int(time.time()),
         "ladder": list(registry.ladder),
         "tiers": list(tiers),
+        # the scheduler verify classes this build covers (see
+        # FAMILY_TIERS); --verify fails when any class a node
+        # dispatches — incl. the lightserve serving plane — finds its
+        # reachable tiers missing from the built entries
+        "families": sorted(
+            f
+            for f, req in FAMILY_TIERS.items()
+            if all(t in tiers for t in req)
+        ),
         "device_count": verifier.mesh_devices,
         "mesh_min_rows": verifier._mesh_min_rows,
         # the backend the mesh was built on: --verify must count live
@@ -134,6 +157,34 @@ def check_budget(manifest: dict, budget: int) -> list[str]:
             problems.append(
                 f"tier {tier}: {len(shapes)} distinct shapes > budget "
                 f"{budget}: {sorted(shapes)}"
+            )
+    return problems
+
+
+def check_families(manifest: dict, families=None) -> list[str]:
+    """Per-family tier coverage violations (empty = pass): every verify
+    class the manifest claims to cover must find its reachable tiers
+    among the built entries — a `--tiers generic` manifest covers NO
+    commit-verify class, and a node trusting it would compile the
+    lightserve swarm's shared rounds (or any commit verify) on the hot
+    path."""
+    problems = []
+    built_tiers = {e["tier"] for e in manifest.get("entries", ())}
+    for family in families or manifest.get("families", ()):
+        required = FAMILY_TIERS.get(family)
+        if required is None:
+            # an unknown name (operator typo in --families) must FAIL,
+            # not silently report coverage that was never checked
+            problems.append(
+                f"family {family!r} is not a known verify class "
+                f"(known: {sorted(FAMILY_TIERS)})"
+            )
+            continue
+        missing = [t for t in required if t not in built_tiers]
+        if missing:
+            problems.append(
+                f"family {family}: reachable tier(s) {missing} not in "
+                f"the manifest (built tiers: {sorted(built_tiers)})"
             )
     return problems
 
@@ -211,6 +262,14 @@ def main() -> int:
         type=int,
         default=8,
         help="max distinct program shapes per tier",
+    )
+    ap.add_argument(
+        "--families",
+        default="",
+        help="--verify: comma-separated scheduler verify classes whose "
+        "reachable tiers the manifest must cover (e.g. "
+        "'light,lightserve'); default: the manifest's recorded "
+        "coverage, or every known class for manifests without one",
     )
     ap.add_argument(
         "--verify",
@@ -300,6 +359,26 @@ def main() -> int:
     for p in problems:
         print(f"BUDGET VIOLATION: {p}")
         rc = 1
+    if args.verify:
+        # family coverage: an explicit --families is the operator's
+        # requirement; a manifest that recorded its coverage is checked
+        # against that intent (an explicitly partial --tiers build
+        # stays partial); a node-built / legacy manifest without the
+        # key must cover EVERY class the node dispatches — including
+        # the lightserve serving plane's shared rounds
+        if args.families.strip():
+            required = [
+                f.strip() for f in args.families.split(",") if f.strip()
+            ]
+        elif "families" in prior:
+            required = prior["families"]
+        else:
+            required = sorted(FAMILY_TIERS)
+        family_problems = check_families(manifest, families=required)
+        for p in family_problems:
+            print(f"FAMILY COVERAGE: {p}")
+            rc = 1
+        problems = problems + family_problems
 
     if args.verify:
         slow = [
