@@ -253,19 +253,42 @@ def main() -> None:
     ap.add_argument(
         "--family",
         default="",
-        choices=("", "consensus_pacing", "lightserve"),
+        choices=("", "consensus_pacing", "lightserve", "committee_scale"),
         help="run ONE named bench family instead of the device "
         "throughput suite. 'consensus_pacing' measures wall-per-height "
         "static vs adaptive timeouts on the 4-validator harness; "
         "'lightserve' drives an N-thousand light-client swarm through "
-        "the serving plane (tools/lightserve_bench.py). Both are "
-        "wall-clock families, valid on the CPU backend.",
+        "the serving plane (tools/lightserve_bench.py); "
+        "'committee_scale' sweeps 100+-validator in-proc p2p nets over "
+        "the batched vote-gossip plane. All are wall-clock families, "
+        "valid on the CPU backend.",
     )
     ap.add_argument(
         "--clients",
         type=int,
         default=1000,
         help="lightserve family: simulated light clients in the swarm",
+    )
+    ap.add_argument(
+        "--sizes",
+        default="4,32,100,200",
+        help="committee_scale family: committee sizes to sweep",
+    )
+    ap.add_argument(
+        "--straggler-ms",
+        type=float,
+        default=50.0,
+        help="committee_scale family: chaos link delay for the "
+        "straggler scenario (0 disables it)",
+    )
+    ap.add_argument(
+        "--live-max",
+        type=int,
+        default=100,
+        help="committee_scale family: largest committee to run as a "
+        "LIVE in-proc net (larger sizes still get the dissemination "
+        "and BLS metrics; a 200-node single-process net is minutes "
+        "per height on one CPU)",
     )
     args = ap.parse_args()
 
@@ -277,6 +300,20 @@ def main() -> None:
         return
     if args.family == "lightserve":
         print(json.dumps(_bench_lightserve(n_clients=args.clients)))
+        return
+    if args.family == "committee_scale":
+        sizes = tuple(
+            int(s) for s in args.sizes.split(",") if s.strip()
+        )
+        print(
+            json.dumps(
+                _bench_committee_scale(
+                    sizes=sizes,
+                    straggler_s=args.straggler_ms / 1e3,
+                    live_max=args.live_max,
+                )
+            )
+        )
         return
 
     # the CPU-fallback child already probed and pinned JAX_PLATFORMS=cpu;
@@ -668,6 +705,376 @@ def _bench_lightserve(n_clients: int = 1000, heights: int = 8) -> dict:
             },
         ],
         "scenarios": scenarios,
+    }
+
+
+def _committee_config(n: int):
+    """Static timeouts generous enough that a CPU-backed in-proc
+    committee never advances rounds on verify latency — the bench
+    measures the gossip plane, not timeout churn. Adaptive pacing off:
+    one variable at a time."""
+    from tendermint_tpu.consensus.state_machine import ConsensusConfig
+
+    scale = 1.0 + n / 25.0
+    return ConsensusConfig(
+        timeout_propose=10.0 * scale,
+        timeout_propose_delta=2.0,
+        timeout_prevote=10.0 * scale,
+        timeout_prevote_delta=2.0,
+        timeout_precommit=10.0 * scale,
+        timeout_precommit_delta=2.0,
+        timeout_commit=0.05,
+        skip_timeout_commit=True,
+    )
+
+
+def _run_committee_net(
+    n: int,
+    heights: int = 2,
+    warm: int = 1,
+    batch: bool = True,
+    straggler_s: float = 0.0,
+    stub_verify=None,
+) -> dict:
+    """One committee-scale measurement: an n-validator in-proc net over
+    REAL encrypted p2p (tests/chaos_harness) with zipf-weighted powers,
+    ring+chords topology past the full-mesh knee, and a process-wide
+    VerifyScheduler so every node's vote chunks coalesce into shared
+    dispatch rounds. batch=False builds legacy one-vote-per-tick
+    reactors (the baseline variant — only run at small sizes; at 100+
+    the one-vote wire is exactly the pathology this family measures).
+    straggler_s > 0 delays one heavy-validator link after warmup
+    (chaos straggler regime). stub_verify (default: auto, n > 32)
+    replaces signature verification with an all-accept stub: a shared
+    single-process event loop cannot absorb 100+ nodes' device
+    verifies (each blocks every node at once), so committee-scale live
+    walls measure the gossip/consensus plane and are labeled as such —
+    real-crypto dispatch accounting comes from the n <= 32 runs."""
+    import asyncio
+    import contextlib
+
+    from tendermint_tpu import obs
+    from tendermint_tpu.chaos import ChaosNetwork, LinkPolicy
+    from tendermint_tpu.parallel.scheduler import (
+        VerifyScheduler,
+        set_default_scheduler,
+    )
+    from tests.chaos_harness import (
+        AllTrueVerifier,
+        build_chaos_handles,
+        start_mesh,
+        stop_mesh,
+        stub_default_verifier,
+        zipf_powers,
+    )
+
+    if stub_verify is None:
+        stub_verify = n > 32
+    tracer = obs.Tracer(enabled=True, ring_size=65536)
+    handles = build_chaos_handles(
+        powers=zipf_powers(n),
+        config=_committee_config(n),
+        vote_batch=batch,
+        verifier_factory=AllTrueVerifier if stub_verify else None,
+        # node 0 records quorum attribution; per-node rings at 200
+        # validators would be ~all of the bench's memory for no signal
+        tracer_factory=lambda name: (
+            tracer if name == "n0" else obs.Tracer(enabled=False)
+        ),
+        ping_interval=30.0,
+    )
+    degree = 0 if n <= 8 else 4
+    timeout = 120 + n * 3 * (warm + heights)
+    stub_ctx = (
+        stub_default_verifier() if stub_verify else contextlib.nullcontext()
+    )
+
+    async def run():
+        sched = VerifyScheduler()
+        await sched.start()
+        set_default_scheduler(sched)
+        net = None
+        if straggler_s > 0:
+            net = ChaosNetwork(seed=7)
+            for h in handles:
+                net.install(h)
+        try:
+            await start_mesh(handles, peer_degree=degree)
+            await asyncio.gather(
+                *(
+                    h.cs.wait_for_height(warm, timeout=timeout)
+                    for h in handles
+                )
+            )
+            if net is not None:
+                # delay every link OUT of the last-index validator: at
+                # zipf powers it is the lightest, so quorum never stalls
+                # on it but its votes are the measured stragglers
+                lagger = handles[-1].name
+                for other in handles[:-1]:
+                    net.set_link_policy(
+                        lagger,
+                        other.name,
+                        LinkPolicy(latency_s=straggler_s),
+                        reverse=LinkPolicy(),
+                    )
+            for h in handles:
+                r = h.switch.reactors["consensus"]
+                r.gossip_ticks = 0
+                r.gossip_idle_ticks = 0
+                r.gossip_votes_sent = 0
+                r.gossip_batches_sent = 0
+            tracer.clear()
+            before = _reg_snapshot()
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(
+                    h.cs.wait_for_height(warm + heights, timeout=timeout)
+                    for h in handles
+                )
+            )
+            wall = time.perf_counter() - t0
+            ticks = votes = idle = batches = 0
+            for h in handles:
+                r = h.switch.reactors["consensus"]
+                ticks += r.gossip_ticks
+                votes += r.gossip_votes_sent
+                idle += r.gossip_idle_ticks
+                batches += r.gossip_batches_sent
+            return wall, ticks, votes, idle, batches, _shape_stats(before)
+        finally:
+            await stop_mesh(handles)
+            set_default_scheduler(None)
+            await sched.stop()
+
+    with stub_ctx:
+        wall, ticks, votes, idle, batches, reg = asyncio.run(run())
+    # quorum-close lag on node 0's ring (the same sketch rule the
+    # pacing controllers and prior BENCH artifacts use)
+    from tendermint_tpu.obs import StreamingQuantile
+
+    sketch = StreamingQuantile(window=4096)
+    sketch.extend(
+        float((r.get("fields") or {}).get("lag_ms", 0.0))
+        for r in (rec.to_json() for rec in tracer.records())
+        if r.get("name") == "quorum.close"
+        and (r.get("fields") or {}).get("type") == "precommit"
+    )
+    out = {
+        "n": n,
+        "heights": heights,
+        "variant": "batched" if batch else "one_vote_per_tick",
+        "sig_verify": "stubbed" if stub_verify else "real",
+        "peer_degree": degree or (n - 1),
+        "wall_ms_per_height": round(wall / heights * 1e3, 1),
+        "gossip_ticks": ticks,
+        "gossip_idle_ticks": idle,
+        "gossip_votes_sent": votes,
+        "gossip_batches_sent": batches,
+        "votes_per_gossip_tick": round(votes / ticks, 2) if ticks else 0.0,
+        **reg,
+    }
+    if straggler_s > 0:
+        out["straggler_ms"] = straggler_s * 1e3
+    if len(sketch):
+        out["quorum_close_lag_p50_ms"] = round(sketch.quantile(0.5), 3)
+        out["quorum_close_lag_p95_ms"] = round(sketch.quantile(0.95), 3)
+    return out
+
+
+def _bench_bls_committee(n_signers: int = 150) -> dict:
+    """Batch-point BLS aggregation at committee scale: n_signers real
+    BLS12-381 dual-signs over ONE batch hash, submitted to the
+    BLSBatcher as one chunk — must verify as O(1) fn-lane dispatch
+    rounds (one aggregate, 2 pairings) regardless of committee size."""
+    import asyncio
+
+    from tendermint_tpu.consensus.bls_batcher import BLSBatcher
+    from tendermint_tpu.crypto import bls_signatures as bls
+    from tendermint_tpu.l2node.mock import MockL2Node
+
+    registry = bls.BLSKeyRegistry()
+    tm_keys = []
+    sigs = []
+    batch_hash = b"committee-batch-point-hash-32b!!"
+    for i in range(n_signers):
+        priv = 50021 + i
+        tm_pk = b"tmkey-%03d" % i + b"\x00" * 23
+        registry.register(tm_pk, bls.pubkey_from_priv(priv))
+        tm_keys.append(tm_pk)
+        sigs.append(bls.signer_for(priv)(batch_hash))
+    l2 = MockL2Node(
+        bls_verifier=registry.verifier(),
+        bls_batch_verifier=registry.batch_verifier(),
+    )
+    batcher = BLSBatcher(l2)
+    before = _reg_snapshot()
+
+    async def run():
+        t0 = time.perf_counter()
+        verdicts = await batcher.submit_many(
+            list(zip(tm_keys, [batch_hash] * n_signers, sigs))
+        )
+        dt = time.perf_counter() - t0
+        rounds = len(batcher.batch_sizes)
+        batcher.stop()
+        return verdicts, dt, rounds
+
+    verdicts, dt, rounds = asyncio.run(run())
+    assert all(v is True for v in verdicts), "committee BLS batch rejected"
+    return {
+        "metric": "bls_batch_point_committee",
+        "value": round(dt * 1e3, 1),
+        "unit": (
+            f"ms for {n_signers} dual-signs over one batch hash "
+            f"({rounds} fn-lane dispatch round(s))"
+        ),
+        "vs_baseline": rounds,  # O(1) rounds per batch point
+        **_shape_stats(before),
+    }
+
+
+def _bench_round_dissemination(sizes) -> list:
+    """Controlled per-round gossip cost (tests/chaos_harness
+    round_dissemination_ticks): node A holds a full n-validator
+    prevote round, real-p2p peer B holds none; count A's gossip send
+    events until B's set is full, batched vs the one-vote-per-tick
+    baseline. Deterministic — the emergent live-net number below is
+    arrival-rate-bound, this one isolates the wire model."""
+    import asyncio
+
+    from tests.chaos_harness import round_dissemination_ticks
+
+    out = []
+    for n in sizes:
+        batched = asyncio.run(round_dissemination_ticks(n, True))
+        base = asyncio.run(round_dissemination_ticks(n, False))
+        out.append({"batched": batched, "baseline": base})
+    return out
+
+
+def _bench_committee_scale(
+    sizes=(4, 32, 100, 200),
+    heights: int = 2,
+    straggler_s: float = 0.05,
+    live_max: int = 100,
+) -> dict:
+    """committee_scale family (PERF_ANALYSIS §16), three layers:
+
+    1. round dissemination (headline): gossip ticks to ship one full
+       n-validator vote round to a peer, batched vs one-vote-per-tick,
+       at every requested size — vs_baseline is the tick ratio at the
+       largest size >= 100 (the ISSUE's '>=10x fewer gossip ticks').
+    2. live sweep: in-proc real-p2p committee nets (zipf powers,
+       ring+chords degree 4) closing heights — wall-per-height,
+       emergent votes-per-gossip-tick, quorum-close lag, and
+       device-dispatch counts per size. Sizes above `live_max` skip
+       the live net by default (a 200-node single-process net is
+       minutes per height on one CPU; pass --sizes to force).
+    3. BLS committee batch point: 150 dual-signs, one batch hash, one
+       fn-lane round.
+
+    The one-vote-per-tick live baseline runs at sizes <= 32."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        dissemination = _bench_round_dissemination(sizes)
+    except Exception as e:
+        print(f"# dissemination metric failed: {e!r}", file=sys.stderr)
+        dissemination = []
+    sweep = []
+    for n in (s for s in sizes if s <= live_max):
+        hts = heights if n < 100 else max(1, heights - 1)
+        try:
+            sweep.append(_run_committee_net(n, heights=hts))
+        except Exception as e:
+            print(f"# committee size {n} failed: {e!r}", file=sys.stderr)
+            sweep.append({"n": n, "error": repr(e)})
+    baseline = []
+    for n in (s for s in sizes if s <= 32):
+        try:
+            baseline.append(
+                _run_committee_net(n, heights=heights, batch=False)
+            )
+        except Exception as e:
+            print(f"# baseline size {n} failed: {e!r}", file=sys.stderr)
+            baseline.append({"n": n, "error": repr(e)})
+    straggler = None
+    if straggler_s > 0:
+        try:
+            straggler = _run_committee_net(
+                32, heights=heights, straggler_s=straggler_s
+            )
+        except Exception as e:
+            print(f"# straggler scenario failed: {e!r}", file=sys.stderr)
+            straggler = {"error": repr(e)}
+    # headline: dissemination tick ratio at the largest complete size
+    # (preferring committee scale >= 100)
+    ratio = 0.0
+    head_n = None
+    complete = [
+        d
+        for d in dissemination
+        if d["batched"].get("complete") and d["baseline"].get("complete")
+    ]
+    committee = [d for d in complete if d["batched"]["n"] >= 100]
+    pool = committee or complete
+    if pool:
+        pick = max(pool, key=lambda d: d["batched"]["n"])
+        head_n = pick["batched"]["n"]
+        ratio = pick["baseline"]["gossip_ticks"] / max(
+            1, pick["batched"]["gossip_ticks"]
+        )
+    extra = [
+        {
+            "metric": f"committee_round_ticks_n{d['batched']['n']}",
+            "value": d["batched"]["gossip_ticks"],
+            "unit": (
+                f"gossip ticks to disseminate one "
+                f"{d['batched']['n']}-validator round (baseline "
+                f"{d['baseline']['gossip_ticks']}; "
+                f"{d['batched']['wall_ms']} ms wall)"
+            ),
+            "vs_baseline": round(
+                d["baseline"]["gossip_ticks"]
+                / max(1, d["batched"]["gossip_ticks"]),
+                1,
+            ),
+        }
+        for d in dissemination
+        if d["batched"].get("complete")
+    ] + [
+        {
+            "metric": f"committee_wall_per_height_n{s['n']}",
+            "value": s["wall_ms_per_height"],
+            "unit": (
+                f"ms/height ({s['variant']}, degree {s['peer_degree']}, "
+                f"votes/tick {s['votes_per_gossip_tick']}, "
+                f"quorum close p95 "
+                f"{s.get('quorum_close_lag_p95_ms', 'n/a')} ms, "
+                f"{s['device_dispatch_count']} device dispatches)"
+            ),
+        }
+        for s in sweep
+        if "error" not in s
+    ]
+    try:
+        extra.append(_bench_bls_committee())
+    except Exception as e:
+        print(f"# bls committee metric failed: {e!r}", file=sys.stderr)
+    return {
+        "metric": "committee_round_gossip_tick_reduction",
+        "value": round(ratio, 1),
+        "unit": (
+            f"x fewer gossip ticks per {head_n}-validator round vs the "
+            f"one-vote-per-tick baseline (batched chunks of 64)"
+        ),
+        "vs_baseline": round(ratio, 1),
+        "meta": _meta_block(),
+        "dissemination": dissemination,
+        "sweep": sweep,
+        "baseline": baseline,
+        "straggler": straggler,
+        "extra_metrics": extra,
     }
 
 

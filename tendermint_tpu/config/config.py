@@ -166,6 +166,13 @@ class ConsensusTimeoutsConfig:
     adaptive_min_samples: int = 8
     adaptive_backoff_step: float = 0.5
     adaptive_recover_step: float = 0.1
+    # --- committee-scale vote gossip (consensus/reactor.py) ---------------
+    # ship all votes a peer is missing per gossip tick in bounded
+    # VoteBatchMessage chunks (peers negotiate via the advertised
+    # VOTE_BATCH_CHANNEL; legacy peers keep the one-vote-per-tick wire).
+    # Reactor knobs, not state-machine fields.
+    vote_batch_gossip: bool = True
+    vote_batch_max: int = 64
 
     # every timeout/adaptive knob to_state_machine_config() carries over;
     # a field added to the state-machine ConsensusConfig MUST be listed
@@ -200,6 +207,8 @@ class ConsensusTimeoutsConfig:
         ):
             if getattr(self, f) < 0:
                 raise ValueError(f"consensus.{f} cannot be negative")
+        if self.vote_batch_max < 1:
+            raise ValueError("consensus.vote_batch_max must be >= 1")
         if self.adaptive_timeouts:
             # the controller's own validation, surfaced at config load
             # instead of node assembly; from_knobs is the ONE mapping

@@ -179,6 +179,97 @@ class VoteMessage:
 
 
 @dataclass
+class VoteBatchMessage:
+    """A chunk of votes for one (height, round, type) vote set — the
+    committee-scale replacement for trickling one VoteMessage per gossip
+    tick. Gossiped on VOTE_BATCH_CHANNEL, which only batch-capable peers
+    advertise (legacy peers keep receiving single VoteMessages). Each
+    vote still carries its own full identity; the envelope fields are
+    the sender's bookkeeping hint, not trusted routing."""
+
+    height: int
+    round: int
+    type: int
+    votes: list[Vote] = field(default_factory=list)
+    # in-proc only (never wire-encoded): per-vote verdicts from the
+    # reactor's micro-batchers, aligned with `votes` — the state machine
+    # skips its serial checks for pre-verified entries (same contract as
+    # VoteMessage.pre_verified, per element)
+    pre_verified: Optional[list[bool]] = None
+    bls_pre_verified: Optional[list[bool]] = None
+
+    TAG = 10
+
+    def encode(self) -> bytes:
+        return b"".join(
+            [
+                pio.field_varint(1, self.height),
+                pio.field_varint(2, self.round + 1),
+                pio.field_varint(3, self.type),
+            ]
+            + [pio.field_message(4, v.encode()) for v in self.votes]
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VoteBatchMessage":
+        f = pio.decode_fields(data)
+        return cls(
+            height=f.get(1, [0])[0],
+            round=f.get(2, [1])[0] - 1,
+            type=f.get(3, [0])[0],
+            votes=[Vote.decode(d) for d in f.get(4, [])],
+        )
+
+    def iter_flags(self):
+        """(vote, pre_verified, bls_pre_verified) triples; wire-decoded
+        batches (flags None) yield False — the state machine then runs
+        its serial checks exactly as for a plain VoteMessage."""
+        pre = self.pre_verified or (False,) * len(self.votes)
+        bls = self.bls_pre_verified or (False,) * len(self.votes)
+        return zip(self.votes, pre, bls)
+
+
+@dataclass
+class HasVotesMessage:
+    """Aggregate possession digest: 'I hold exactly these votes for
+    (height, round, type)' as one bitmap — the committee-scale
+    replacement for per-vote HasVote floods between batch-capable
+    peers. Rides VOTE_BATCH_CHANNEL (legacy peers never see it; they
+    keep receiving per-vote HasVote). Receivers OR it into their view
+    of the peer, so relays stop re-shipping votes the peer already
+    got from another path."""
+
+    height: int
+    round: int
+    type: int
+    votes: BitArray = field(default_factory=lambda: BitArray(0))
+
+    TAG = 11
+
+    def encode(self) -> bytes:
+        return b"".join(
+            [
+                pio.field_varint(1, self.height),
+                pio.field_varint(2, self.round + 1),
+                pio.field_varint(3, self.type),
+                pio.field_varint(4, self.votes.size),
+                pio.field_bytes(5, self.votes.to_bytes()),
+            ]
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "HasVotesMessage":
+        f = pio.decode_fields(data)
+        size = f.get(4, [0])[0]
+        return cls(
+            height=f.get(1, [0])[0],
+            round=f.get(2, [1])[0] - 1,
+            type=f.get(3, [0])[0],
+            votes=BitArray.from_bytes(size, f.get(5, [b""])[0]),
+        )
+
+
+@dataclass
 class HasVoteMessage:
     height: int
     round: int
@@ -285,6 +376,8 @@ _BY_TAG = {
         HasVoteMessage,
         VoteSetMaj23Message,
         VoteSetBitsMessage,
+        VoteBatchMessage,
+        HasVotesMessage,
     )
 }
 

@@ -64,6 +64,21 @@ class MicroBatcher:
         self._wakeup.set()
         return await fut
 
+    async def submit_items(self, items: list) -> list:
+        """Queue a whole chunk under ONE wakeup; resolves to the aligned
+        verdict list. A committee-sized vote batch lands in the worker's
+        next batch as a unit — one _verify_items call (one scheduler
+        dispatch round) instead of N trickled submits racing the
+        batch-formation window."""
+        if not items:
+            return []
+        self._ensure_worker()
+        loop = asyncio.get_running_loop()
+        futs = [loop.create_future() for _ in items]
+        self._queue.extend(zip(items, futs))
+        self._wakeup.set()
+        return list(await asyncio.gather(*futs))
+
     async def _run(self) -> None:
         while True:
             if not self._queue:

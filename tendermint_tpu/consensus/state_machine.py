@@ -46,7 +46,12 @@ from ..types.vote import Vote, VoteType
 from ..types.vote_set import ConflictingVoteError, VoteSet
 from .batch import BatchCache, get_batch_start
 from .height_vote_set import HeightVoteSet
-from .messages import BlockPartMessage, ProposalMessage, VoteMessage
+from .messages import (
+    BlockPartMessage,
+    ProposalMessage,
+    VoteBatchMessage,
+    VoteMessage,
+)
 from .pacing import (
     STEP_PRECOMMIT,
     STEP_PREVOTE,
@@ -468,6 +473,15 @@ class ConsensusState:
                 pre_verified=msg.pre_verified,
                 bls_pre_verified=msg.bls_pre_verified,
             )
+        elif isinstance(msg, VoteBatchMessage):
+            # a committee-sized chunk enters the vote sets as one unit:
+            # one WAL record, one queue put, one pass over the votes —
+            # per-vote semantics (conflict capture, quorum transitions)
+            # identical to N single VoteMessages in the same order
+            for vote, pre, bls in msg.iter_flags():
+                await self._try_add_vote(
+                    vote, peer_id, pre_verified=pre, bls_pre_verified=bls
+                )
         else:
             self.logger.error("unknown msg type", msg=type(msg).__name__)
 

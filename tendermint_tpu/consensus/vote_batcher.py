@@ -51,6 +51,17 @@ class VoteBatcher(MicroBatcher):
         verdict = await self.submit_item(SigItem(pubkey, msg, sig, key_type))
         return bool(verdict)
 
+    async def submit_many(self, sigs: list) -> list[bool]:
+        """Queue a whole vote-batch chunk — `sigs` is (pubkey, msg, sig,
+        key_type) tuples — as ONE submission: the chunk rides a single
+        _verify_items call and therefore a single scheduler dispatch
+        round, instead of N per-vote submits trickling into whatever
+        batch windows happen to be open."""
+        verdicts = await self.submit_items(
+            [SigItem(pk, msg, sig, kt) for pk, msg, sig, kt in sigs]
+        )
+        return [bool(v) for v in verdicts]
+
     def _verify_items(self, items: list) -> list:
         # runs in an executor thread (microbatch.py) — the scheduler's
         # blocking bridge is safe here and keeps the loop live

@@ -35,11 +35,15 @@ import threading
 # Canonical bucket ladder: small buckets for consensus latency (votes
 # trickle in), large for blocksync/light bulk replay. 16384 is the
 # measured throughput knee of the bulk tier (PERF_ANALYSIS §10: 32768
-# buys +4% for 2x per-batch latency). Batches beyond the top rung pad
-# to multiples of it. Override per-process with `configure_default`
+# buys +4% for 2x per-batch latency). 256 is the committee-scale rung
+# (PERF_ANALYSIS §16): batched vote gossip and batch-point BLS bursts
+# at 100-200 validators land whole-committee chunks that would
+# otherwise pad 129-vote batches all the way to 512 (fill 0.25 at 129
+# vs 0.5+ on the 256 rung). Batches beyond the top rung pad to
+# multiples of it. Override per-process with `configure_default`
 # (node assembly applies [scheduler] bucket_ladder before the first
 # verifier is built).
-DEFAULT_BUCKET_LADDER = (8, 32, 128, 512, 2048, 8192, 16384)
+DEFAULT_BUCKET_LADDER = (8, 32, 128, 256, 512, 2048, 8192, 16384)
 
 
 class ShapeRegistry:

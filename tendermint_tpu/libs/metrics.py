@@ -481,6 +481,23 @@ class ConsensusMetrics:
             "a pacing failure signal that backs the controller off)",
             ("step",),
         )
+        # --- committee-scale vote plane (consensus/reactor.py) ------------
+        # gossip efficiency: ticks that shipped >= 1 vote, and votes
+        # shipped — votes/tick is the one-vote-per-tick baseline's 1.0
+        # lifted toward vote_batch_max by VoteBatchMessage chunks
+        self.vote_gossip_ticks = reg.counter(
+            "consensus_vote_gossip_ticks_total",
+            "Vote-gossip loop passes that sent at least one vote",
+        )
+        self.vote_gossip_votes = reg.counter(
+            "consensus_vote_gossip_votes_total",
+            "Votes shipped by the vote-gossip routines (all peers)",
+        )
+        self.vote_batch_size = reg.histogram(
+            "consensus_vote_batch_size",
+            "Votes per VoteBatchMessage chunk shipped",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, float("inf")),
+        )
         self.proposal_gossip_seconds = reg.histogram(
             "consensus_proposal_gossip_seconds",
             "Proposer's proposal timestamp to our receipt, per sending "

@@ -492,7 +492,10 @@ class Node(Service):
             pacing=self.pacing,
         )
         self.consensus_reactor = ConsensusReactor(
-            self.consensus, logger=self.logger
+            self.consensus,
+            logger=self.logger,
+            vote_batch=config.consensus.vote_batch_gossip,
+            vote_batch_max=config.consensus.vote_batch_max,
         )
 
         # --- blocksync (node.go:435-458) ---

@@ -9,6 +9,7 @@ consensus state — the "restart" scenario action.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 
 from tendermint_tpu.chaos import NodeHandle
 from tendermint_tpu.consensus.reactor import ConsensusReactor
@@ -27,7 +28,7 @@ from tests.test_consensus import make_node
 NETWORK = "chaos-chain"
 
 
-def _wire_node(cs, nk, ping_interval: float = 10.0):
+def _wire_node(cs, nk, ping_interval: float = 10.0, vote_batch: bool = True):
     """Fresh transport + switch + consensus reactor for one node."""
     transport = None
     sw = None
@@ -42,8 +43,15 @@ def _wire_node(cs, nk, ping_interval: float = 10.0):
 
     transport = MultiplexTransport(nk, node_info)
     sw = Switch(transport, ping_interval=ping_interval)
-    sw.add_reactor("consensus", ConsensusReactor(cs))
+    sw.add_reactor("consensus", ConsensusReactor(cs, vote_batch=vote_batch))
     return transport, sw
+
+
+def zipf_powers(n: int, s: float = 1.0, base: int = 1000) -> list[int]:
+    """Zipf-distributed voting powers (rank-k power ~ base/k^s, min 1):
+    the committee-scale weighted-power shape where a few heavyweights
+    dominate the quorum — deterministic, no RNG."""
+    return [max(1, int(base / (k + 1) ** s)) for k in range(n)]
 
 
 def build_chaos_handles(
@@ -52,6 +60,8 @@ def build_chaos_handles(
     ping_interval: float = 10.0,
     powers=None,
     config=None,
+    vote_batch: bool = True,
+    verifier_factory=None,
 ) -> list[NodeHandle]:
     """n validator NodeHandles (not yet listening/started).
 
@@ -61,7 +71,12 @@ def build_chaos_handles(
     `ping_interval` makes the peer clock-offset EWMAs converge inside a
     short run. `powers` gives per-validator voting powers (n_i holds the
     key of validator index i in the sorted set). `config` overrides the
-    per-node ConsensusConfig (adaptive-pacing scenarios)."""
+    per-node ConsensusConfig (adaptive-pacing scenarios). `vote_batch`
+    False builds legacy one-vote-per-tick reactors (the committee_scale
+    bench's baseline variant).
+
+    Setup is O(n): per-node work touches only that node's keys/stores,
+    and topology cost is deferred to start_mesh's peer_degree."""
     if powers is not None:
         vs, pvs = make_weighted_validators(powers)
         n = len(powers)
@@ -72,10 +87,17 @@ def build_chaos_handles(
     for i, pv in enumerate(pvs):
         tracer = tracer_factory(f"n{i}") if tracer_factory else None
         cs, app, l2, bs, ss = make_node(
-            vs, pv, genesis, tracer=tracer, config=config
+            vs,
+            pv,
+            genesis,
+            tracer=tracer,
+            config=config,
+            verifier=verifier_factory() if verifier_factory else None,
         )
         nk = NodeKey.generate()
-        transport, sw = _wire_node(cs, nk, ping_interval=ping_interval)
+        transport, sw = _wire_node(
+            cs, nk, ping_interval=ping_interval, vote_batch=vote_batch
+        )
         handles.append(
             NodeHandle(
                 name=f"n{i}",
@@ -88,6 +110,177 @@ def build_chaos_handles(
             )
         )
     return handles
+
+
+class AllTrueVerifier:
+    """Signature-verification stub for committee-scale gossip-plane
+    harnesses: an in-proc 100+-node net shares ONE event loop, and a
+    real device verify (worse, its first-dispatch XLA compile) blocks
+    every node at once — with the stub, wall time measures the gossip
+    and consensus planes. Batch/scheduler plumbing is exercised
+    identically; verdicts are all-accept."""
+
+    def __init__(self):
+        import threading
+
+        self.shutdown_event = threading.Event()
+
+    def verify(self, items):
+        import numpy as np
+
+        return np.ones(len(items), dtype=bool)
+
+    def verify_one(self, *a):
+        return True
+
+    def warm(self, *a, **k):
+        return None
+
+
+@contextlib.contextmanager
+def stub_default_verifier():
+    """Route default_verifier() callers (block validation's
+    verify_commit_light among them) through AllTrueVerifier for the
+    duration — per-node injection alone misses them."""
+    from tendermint_tpu.crypto import batch_verifier as bv
+
+    saved = bv._default
+    bv._default = AllTrueVerifier()
+    try:
+        yield
+    finally:
+        bv._default = saved
+
+
+async def round_dissemination_ticks(
+    n: int, batch: bool, chunk_max: int = 64
+) -> dict:
+    """Deterministic measurement of the vote plane's per-round gossip
+    cost: node A holds a full n-validator prevote round, node B (real
+    encrypted p2p peer) holds none — count A's vote-gossip send events
+    (ticks) until B's vote set is full. The one-vote-per-tick baseline
+    (batch=False) is structurally n ticks; the batched plane ships
+    ceil(n / vote_batch_max) chunks. Signature verification is stubbed
+    on both ends (the measurement is the gossip plane, pre-verification
+    plumbing is exercised identically)."""
+    import numpy as np
+
+    from tendermint_tpu.consensus.state_machine import ConsensusConfig
+    from tendermint_tpu.consensus.vote_batcher import VoteBatcher
+    from tendermint_tpu.types.block_id import BlockID
+    from tendermint_tpu.types.part_set import PartSetHeader
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    class _AllTrue:
+        def verify(self, items):
+            return np.ones(len(items), dtype=bool)
+
+    vs, pvs = make_validators(n)
+    genesis = make_genesis(vs)
+    # nodes must sit still in (h1, r0) for the whole measurement
+    cfg = ConsensusConfig(
+        timeout_propose=600.0,
+        timeout_prevote=600.0,
+        timeout_precommit=600.0,
+        timeout_commit=600.0,
+    )
+    pair = []
+    for pv in pvs[:2]:
+        cs, app, l2, bs, ss = make_node(vs, pv, genesis, config=cfg)
+        nk = NodeKey.generate()
+        transport = None
+        sw = None
+
+        def node_info(nk=nk, t=lambda: transport, s=lambda: sw):
+            return NodeInfo(
+                node_id=nk.id,
+                listen_addr=f"127.0.0.1:{t().listen_port}",
+                network=NETWORK,
+                channels=s().channels() if s() else b"",
+            )
+
+        transport = MultiplexTransport(nk, node_info)
+        sw = Switch(transport, ping_interval=60.0)
+        reactor = ConsensusReactor(
+            cs,
+            vote_batcher=VoteBatcher(verifier=_AllTrue()),
+            vote_batch=batch,
+            vote_batch_max=chunk_max,
+        )
+        sw.add_reactor("consensus", reactor)
+        pair.append((cs, nk, transport, sw, reactor))
+    (cs_a, nk_a, t_a, sw_a, r_a), (cs_b, nk_b, t_b, sw_b, r_b) = pair
+    import asyncio
+    import time
+
+    for _, _, t, sw, _ in pair:
+        await t.listen()
+        await sw.start()
+    await sw_a.dial_peer(NetAddress(nk_b.id, "127.0.0.1", t_b.listen_port))
+    for cs, *_ in pair:
+        await cs.start()
+    try:
+        for _ in range(200):  # both sides see the peer + height 1
+            if (
+                sw_a.peers
+                and sw_b.peers
+                and cs_a.rs.height == 1
+                and cs_b.rs.height == 1
+            ):
+                break
+            await asyncio.sleep(0.02)
+        bid = BlockID(b"d" * 32, PartSetHeader(1, b"d" * 32))
+        target = cs_a.rs.votes.prevotes(0)
+        for i, v in enumerate(vs.validators):
+            target.add_vote(
+                Vote(
+                    type=VoteType.PREVOTE,
+                    height=1,
+                    round=0,
+                    block_id=bid,
+                    timestamp_ns=1,
+                    validator_address=v.address,
+                    validator_index=i,
+                    signature=b"s%06d" % i + b"\x00" * 57,
+                ),
+                verified=True,
+            )
+        ticks0 = r_a.gossip_ticks
+        votes0 = r_a.gossip_votes_sent
+        t0 = time.perf_counter()
+        full = False
+        while time.perf_counter() - t0 < 60:
+            pv_b = cs_b.rs.votes.prevotes(0)
+            if pv_b is not None and pv_b.bit_array().num_set() >= n:
+                full = True
+                break
+            await asyncio.sleep(0.02)
+        wall = time.perf_counter() - t0
+        return {
+            "n": n,
+            "variant": "batched" if batch else "one_vote_per_tick",
+            "complete": full,
+            "gossip_ticks": r_a.gossip_ticks - ticks0,
+            "votes_sent": r_a.gossip_votes_sent - votes0,
+            "wall_ms": round(wall * 1e3, 1),
+        }
+    finally:
+        for cs, _, _, sw, _ in pair:
+            await cs.stop()
+            await sw.stop()
+
+
+def ring_peer_indices(i: int, n: int, degree: int) -> list[int]:
+    """Deterministic sparse topology for committee-scale meshes: node i
+    DIALS its next `degree` ring successors (i+1 .. i+degree mod n), so
+    every edge is dialed exactly once, total edges n*degree instead of
+    the full mesh's n*(n-1)/2, and each node ends with ~2*degree
+    connections. degree >= 1 keeps the ring connected; chords shrink
+    the gossip diameter to ~n/(2*degree)."""
+    if n <= 1:
+        return []
+    degree = max(1, min(degree, n - 1))
+    return [(i + d) % n for d in range(1, degree + 1)]
 
 
 def _make_restart(handles: list[NodeHandle]):
@@ -116,19 +309,32 @@ def _make_restart(handles: list[NodeHandle]):
     return restart
 
 
-async def start_mesh(handles: list[NodeHandle]) -> None:
-    """Listen, start switches, wire a persistent full mesh, start
-    consensus. Chaos must already be installed (ScenarioRunner/
-    ChaosNetwork.install) so transports wrap their connections."""
+async def start_mesh(
+    handles: list[NodeHandle], peer_degree: int = 0
+) -> None:
+    """Listen, start switches, wire the topology, start consensus.
+    Chaos must already be installed (ScenarioRunner/ChaosNetwork.install)
+    so transports wrap their connections.
+
+    peer_degree 0 (default) keeps the original persistent full mesh —
+    O(n^2) connections, right for small nets. A positive degree wires
+    the ring-with-chords topology instead (ring_peer_indices): node i
+    dials only its `degree` ring successors, so a 100+-validator
+    committee comes up with O(n*degree) dials and connections and votes
+    relay through the batched gossip plane."""
     for h in handles:
         await h.transport.listen()
         await h.switch.start()
-    for h in handles:
+    n = len(handles)
+    for i, h in enumerate(handles):
+        if peer_degree > 0:
+            targets = [handles[j] for j in ring_peer_indices(i, n, peer_degree)]
+        else:
+            targets = [o for o in handles if o is not h]
         h.switch.dial_peers_async(
             [
                 NetAddress(o.node_key.id, "127.0.0.1", o.transport.listen_port)
-                for o in handles
-                if o is not h
+                for o in targets
             ],
             persistent=True,
         )

@@ -39,6 +39,7 @@ def make_node(
     bls_signer=None,
     metrics=None,
     tracer=None,
+    verifier=None,
 ):
     l2 = l2 or MockL2Node()
     app = KVStoreApplication()
@@ -59,6 +60,7 @@ def make_node(
         bls_signer=bls_signer,
         metrics=metrics,
         tracer=tracer,
+        verifier=verifier,
     )
     return cs, app, l2, block_store, state_store
 

@@ -69,6 +69,33 @@ FAMILY_TIERS = {
     "lightserve": ("small", "big"),
 }
 
+# committee-scale bucket rungs (PERF_ANALYSIS §16): batched vote gossip
+# ships VOTE_BATCH_MAX-vote chunks (pad to 128) and whole-committee
+# commit verifies at 100-200 validators land on 128/256 — a manifest
+# missing these rungs leaves a committee-scale node compiling its vote
+# path mid-height
+COMMITTEE_BUCKETS = (128, 256)
+
+
+def check_committee_rungs(manifest: dict) -> list[str]:
+    """Committee-rung coverage violations (empty = pass): the manifest's
+    entries must include every COMMITTEE_BUCKETS rung for at least one
+    cached tier. Explicitly-partial ladders (--ladder without the
+    rungs) fail here, which is the point — a committee-scale node warm-
+    started from them compiles the vote path on the hot path."""
+    built = {
+        e["bucket"]
+        for e in manifest.get("entries", ())
+        if e["tier"] in ("small", "big")
+    }
+    missing = [b for b in COMMITTEE_BUCKETS if b not in built]
+    if missing:
+        return [
+            f"committee-scale rung(s) {missing} not in the manifest "
+            f"(built cached-tier buckets: {sorted(built)})"
+        ]
+    return []
+
 
 def _build_mesh(devices: int, backend: str = ""):
     """Mesh over `devices` chips of the backend (0 = all visible; 1 or
@@ -379,6 +406,11 @@ def main() -> int:
             print(f"FAMILY COVERAGE: {p}")
             rc = 1
         problems = problems + family_problems
+        committee_problems = check_committee_rungs(manifest)
+        for p in committee_problems:
+            print(f"COMMITTEE COVERAGE: {p}")
+            rc = 1
+        problems = problems + committee_problems
 
     if args.verify:
         slow = [

@@ -11,11 +11,20 @@ topology.
 
 Usage:
     python tools/testnet_generator.py SEED [OUTDIR]
+        [--validators N] [--power-dist {equal,zipf}]
 prints the manifest; with OUTDIR it also materializes the homes.
+
+Committee-scale configs are one command (`--validators 150
+--power-dist zipf`): the validator count overrides the random
+quorum-friendly default, powers follow the chosen distribution (zipf =
+rank-k power ~ 1000/k, the weighted-committee shape), topology switches
+to ring past the full-mesh knee, and materialization patches every
+node's genesis with the per-validator powers.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import random
@@ -24,14 +33,44 @@ import sys
 
 TOPOLOGIES = ("mesh", "ring", "star")
 PERTURBATIONS = ("none", "kill_restart")
+POWER_DISTS = ("equal", "zipf")
+
+# past this validator count a generated manifest defaults to the ring
+# topology: full-mesh persistent-peer lists grow O(n) per node and
+# O(n^2) connections across the net
+FULL_MESH_MAX_VALIDATORS = 16
 
 
-def generate_manifest(seed: int) -> dict:
-    """Deterministic manifest for `seed` (same seed -> same manifest)."""
+def power_for(dist: str, rank: int, base: int = 1000) -> int:
+    """Voting power of the rank-th validator under `dist` (min 1)."""
+    if dist == "equal":
+        return base
+    if dist == "zipf":
+        return max(1, base // (rank + 1))
+    raise ValueError(f"unknown power dist {dist!r}")
+
+
+def generate_manifest(
+    seed: int,
+    n_validators: int | None = None,
+    power_dist: str = "equal",
+) -> dict:
+    """Deterministic manifest for `seed` (same seed + args -> same
+    manifest). `n_validators` overrides the random quorum-friendly
+    count; `power_dist` assigns per-validator voting powers."""
+    if power_dist not in POWER_DISTS:
+        raise ValueError(f"unknown power dist {power_dist!r}")
     rng = random.Random(seed)
-    n_validators = rng.choice((4, 4, 5))  # quorum-friendly sizes
-    n_fulls = rng.randint(0, 2)
-    topology = rng.choice(TOPOLOGIES)
+    explicit_n = n_validators is not None
+    if not explicit_n:
+        n_validators = rng.choice((4, 4, 5))  # quorum-friendly sizes
+    if n_validators < 1:
+        raise ValueError("need at least one validator")
+    n_fulls = 0 if explicit_n else rng.randint(0, 2)
+    if explicit_n and n_validators > FULL_MESH_MAX_VALIDATORS:
+        topology = "ring"
+    else:
+        topology = rng.choice(TOPOLOGIES)
     nodes = []
     for i in range(n_validators):
         nodes.append(
@@ -41,6 +80,7 @@ def generate_manifest(seed: int) -> dict:
                 # at most one perturbed validator: BFT tolerates f=1 of 4
                 "perturb": "none",
                 "send_rate": rng.choice((0, 5120000)),
+                "power": power_for(power_dist, i),
             }
         )
     victim = rng.randrange(n_validators)
@@ -57,6 +97,7 @@ def generate_manifest(seed: int) -> dict:
     return {
         "seed": seed,
         "topology": topology,
+        "power_dist": power_dist,
         "initial_height_target": 3,
         "nodes": nodes,
     }
@@ -132,6 +173,10 @@ def materialize(manifest: dict, base: str, free_ports) -> dict:
             "perturb": spec["perturb"],
         }
 
+    powers = [n.get("power", 1000) for n in validators]
+    if len(set(powers)) > 1:
+        _patch_genesis_powers(homes, powers)
+
     ids = [
         NodeKey.load_or_generate(
             os.path.join(h, "config", "node_key.json")
@@ -152,14 +197,56 @@ def materialize(manifest: dict, base: str, free_ports) -> dict:
     return out
 
 
+def _patch_genesis_powers(homes: list[str], powers: list[int]) -> None:
+    """Rewrite every home's genesis.json with per-validator powers
+    (position i in the genesis validator list gets powers[i] — the
+    scaffold writes validators in creation order). All homes must carry
+    the IDENTICAL doc or the nets split on genesis hash."""
+    for home in homes:
+        path = os.path.join(home, "config", "genesis.json")
+        with open(path) as f:
+            doc = json.load(f)
+        vals = doc.get("validators", [])
+        if len(vals) != len(powers):
+            raise RuntimeError(
+                f"genesis has {len(vals)} validators, manifest has "
+                f"{len(powers)} powers"
+            )
+        for v, p in zip(vals, powers):
+            v["power"] = str(p)
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+
+
 def main(argv) -> int:
-    if len(argv) < 2:
-        print(__doc__)
-        return 1
-    seed = int(argv[1])
-    manifest = generate_manifest(seed)
+    ap = argparse.ArgumentParser(
+        description="randomized testnet manifest generator"
+    )
+    ap.add_argument("seed", type=int, help="manifest seed")
+    ap.add_argument(
+        "outdir", nargs="?", default="", help="materialize node homes here"
+    )
+    ap.add_argument(
+        "--validators",
+        type=int,
+        default=0,
+        help="validator count (0 = random quorum-friendly default); "
+        "large committees (e.g. 150) switch to the ring topology",
+    )
+    ap.add_argument(
+        "--power-dist",
+        choices=POWER_DISTS,
+        default="equal",
+        help="voting-power distribution across the committee",
+    )
+    args = ap.parse_args(argv[1:])
+    manifest = generate_manifest(
+        args.seed,
+        n_validators=args.validators or None,
+        power_dist=args.power_dist,
+    )
     print(json.dumps(manifest, indent=2))
-    if len(argv) > 2:
+    if args.outdir:
         import socket
 
         def free_ports(k):
@@ -173,7 +260,7 @@ def main(argv) -> int:
                 s.close()
             return ports
 
-        layout = materialize(manifest, argv[2], free_ports)
+        layout = materialize(manifest, args.outdir, free_ports)
         print(json.dumps(layout, indent=2))
     return 0
 
