@@ -253,15 +253,24 @@ def main() -> None:
     ap.add_argument(
         "--family",
         default="",
-        choices=("", "consensus_pacing", "lightserve", "committee_scale"),
+        choices=(
+            "",
+            "consensus_pacing",
+            "lightserve",
+            "committee_scale",
+            "sequencer_stream",
+        ),
         help="run ONE named bench family instead of the device "
         "throughput suite. 'consensus_pacing' measures wall-per-height "
         "static vs adaptive timeouts on the 4-validator harness; "
         "'lightserve' drives an N-thousand light-client swarm through "
         "the serving plane (tools/lightserve_bench.py); "
         "'committee_scale' sweeps 100+-validator in-proc p2p nets over "
-        "the batched vote-gossip plane. All are wall-clock families, "
-        "valid on the CPU backend.",
+        "the batched vote-gossip plane; 'sequencer_stream' drives the "
+        "post-upgrade BlockV2 streaming plane (tools/loadtime.py) "
+        "through a 1-sequencer + N-subscriber net crossing "
+        "UpgradeBlockHeight under sustained load. All are wall-clock "
+        "families, valid on the CPU backend.",
     )
     ap.add_argument(
         "--clients",
@@ -290,6 +299,33 @@ def main() -> None:
         "and BLS metrics; a 200-node single-process net is minutes "
         "per height on one CPU)",
     )
+    ap.add_argument(
+        "--subscribers",
+        type=int,
+        default=8,
+        help="sequencer_stream family: follower peers subscribed to "
+        "the BlockV2 broadcast plane",
+    )
+    ap.add_argument(
+        "--tx-rate",
+        type=int,
+        default=2000,
+        help="sequencer_stream family: sustained injection rate (tx/s) "
+        "into the sequencer's L2 pull path",
+    )
+    ap.add_argument(
+        "--tx-size",
+        type=int,
+        default=256,
+        help="sequencer_stream family: synthetic tx payload bytes",
+    )
+    ap.add_argument(
+        "--stream-blocks",
+        type=int,
+        default=25,
+        help="sequencer_stream family: streamed BlockV2s per "
+        "measurement window",
+    )
     args = ap.parse_args()
 
     if args.family == "consensus_pacing":
@@ -316,39 +352,67 @@ def main() -> None:
         )
         return
 
+    def _require_backend_or_die(status=None) -> None:
+        """--require-backend structured-failure contract (PR 6): a
+        backend mismatch/outage emits ONE parseable artifact with NO
+        fallback row and exits 1. Pass an existing probe result to
+        avoid re-probing."""
+        if status is None:
+            status = probe_backend()
+        got = status.backend if status.available else None
+        if got == args.require_backend:
+            return
+        err = (
+            status.error
+            if not status.available
+            else (
+                f"probed backend {got!r} != required "
+                f"{args.require_backend!r}"
+            )
+        )
+        print(
+            json.dumps(
+                {
+                    "rc": 1,
+                    "error": err,
+                    "backend": got,
+                    "kind": (
+                        status.kind
+                        if not status.available
+                        else "backend_mismatch"
+                    ),
+                    "fallback": "none",
+                    "required_backend": args.require_backend,
+                    "meta": _meta_block(live=False),
+                }
+            )
+        )
+        raise SystemExit(1)
+
+    if args.family == "sequencer_stream":
+        # wall-clock family, CPU-valid — but it honors --require-backend
+        # with the same structured-failure contract as the device suite
+        # (an operator pinning a backend must not get a silent CPU row)
+        if args.require_backend:
+            _require_backend_or_die()
+        print(
+            json.dumps(
+                _bench_sequencer_stream(
+                    subscribers=args.subscribers,
+                    tx_rate=args.tx_rate,
+                    tx_size=args.tx_size,
+                    stream_blocks=args.stream_blocks,
+                )
+            )
+        )
+        return
+
     # the CPU-fallback child already probed and pinned JAX_PLATFORMS=cpu;
     # re-probing there would recurse
     if os.environ.get("TM_TPU_BENCH_CHILD") != "1":
         status = probe_backend()
         if args.require_backend:
-            got = status.backend if status.available else None
-            if got != args.require_backend:
-                err = (
-                    status.error
-                    if not status.available
-                    else (
-                        f"probed backend {got!r} != required "
-                        f"{args.require_backend!r}"
-                    )
-                )
-                print(
-                    json.dumps(
-                        {
-                            "rc": 1,
-                            "error": err,
-                            "backend": got,
-                            "kind": (
-                                status.kind
-                                if not status.available
-                                else "backend_mismatch"
-                            ),
-                            "fallback": "none",
-                            "required_backend": args.require_backend,
-                            "meta": _meta_block(live=False),
-                        }
-                    )
-                )
-                raise SystemExit(1)
+            _require_backend_or_die(status)
         if not status.available:
             _degrade(status)
             return
@@ -706,6 +770,132 @@ def _bench_lightserve(n_clients: int = 1000, heights: int = 8) -> dict:
         ],
         "scenarios": scenarios,
     }
+
+
+def _bench_sequencer_stream(
+    subscribers: int = 8,
+    tx_rate: int = 2000,
+    tx_size: int = 256,
+    stream_blocks: int = 25,
+) -> dict:
+    """sequencer_stream family (PERF_ANALYSIS §17): a 1-sequencer +
+    N-subscriber full-Node net crosses UpgradeBlockHeight under
+    sustained tx load (tools/loadtime.run_sequencer_stream). Rows:
+    blocks/s + MB/s through the BFT plane pre-upgrade (the PR 4 commit
+    pipeline absorbing the write load) and the BlockV2 streaming plane
+    post-upgrade, event-driven apply latency p50/p95 (receipt ->
+    applied; the reference polls at a fixed 10 s tick), encode-once
+    fan-out (exactly one BlockV2 serialization per broadcast block,
+    counter-backed), a chaos-shaped slow subscriber that must not stall
+    the healthy fan-out, and partition/heal catchup over the 0x51 sync
+    window. vs_baseline is the polling-floor replacement: 10 s over the
+    measured p95 apply latency."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.loadtime import run_sequencer_stream
+
+    stats = run_sequencer_stream(
+        n_followers=subscribers,
+        tx_rate=tx_rate,
+        tx_size=tx_size,
+        stream_blocks=stream_blocks,
+    )
+    pre = stats["pre_upgrade"]
+    post = stats["post_upgrade"]
+    chaos = stats.get("chaos_slow_subscriber") or {}
+    catchup = stats.get("catchup_after_heal") or {}
+    p95_s = max(post["apply_latency_p95_ms"], 0.01) / 1e3
+    extra = [
+        {
+            "metric": "sequencer_stream_pre_upgrade_blocks_per_s",
+            "value": pre["blocks_per_s"],
+            "unit": (
+                f"blocks/s over BFT gossip ({pre['blocks']} blocks to "
+                f"the upgrade height, {pre['mb_per_s']} MB/s, commit "
+                f"pipeline {'on' if pre['commit_pipeline'] else 'off'})"
+            ),
+        },
+        {
+            "metric": "sequencer_stream_mb_per_s",
+            "value": post["mb_per_s"],
+            "unit": (
+                f"MB/s of BlockV2 payload applied per subscriber "
+                f"({post['fanout_mb_per_s']} MB/s aggregate across "
+                f"{subscribers} subscribers)"
+            ),
+        },
+        {
+            "metric": "sequencer_apply_latency_p95",
+            "value": post["apply_latency_p95_ms"],
+            "unit": (
+                f"ms receipt->applied (p50 "
+                f"{post['apply_latency_p50_ms']} ms, "
+                f"{post['apply_latency_samples']} samples; the polled "
+                f"reference floor is 10000 ms)"
+            ),
+            "vs_baseline": round(10.0 / p95_s, 1),
+        },
+        {
+            "metric": "sequencer_encodes_per_broadcast_block",
+            "value": post["encodes_per_broadcast_block"],
+            "unit": (
+                f"BlockV2 serializations per broadcast block "
+                f"({post['block_serializations']} serializations / "
+                f"{post['blocks_broadcast']} blocks to {subscribers} "
+                f"subscribers — encode-once fan-out)"
+            ),
+        },
+    ]
+    if chaos:
+        extra.append(
+            {
+                "metric": "sequencer_stream_chaos_slow_subscriber",
+                "value": chaos["healthy_blocks_per_s"],
+                "unit": (
+                    f"healthy-subscriber blocks/s with one "
+                    f"{chaos['link_latency_ms']:.0f} ms shaped link "
+                    f"(clean {chaos['clean_blocks_per_s']}; shaped "
+                    f"follower {chaos['slow_follower_behind']} blocks "
+                    f"behind at window end — fan-out wall bounded by "
+                    f"the healthy peers)"
+                ),
+            }
+        )
+    if catchup:
+        extra.append(
+            {
+                "metric": "sequencer_catchup_after_heal_wall",
+                "value": catchup["wall_s"],
+                "unit": (
+                    f"s for a healed follower {catchup['blocks_behind']}"
+                    f" blocks behind to re-enter the small-gap window "
+                    f"over 0x51 (windowed requests; the 10 s polled "
+                    f"loop needed >= 1 cycle per "
+                    f"{_small_gap_threshold()} heights)"
+                ),
+            }
+        )
+    return {
+        "metric": "sequencer_stream_blocks_per_s",
+        "value": post["blocks_per_s"],
+        "unit": (
+            f"BlockV2/s applied by every one of {subscribers} "
+            f"subscribers post-upgrade ({post['blocks']} blocks, "
+            f"{stats['tx_rate']} tx/s offered load, wall "
+            f"{post['wall_s']} s)"
+        ),
+        "vs_baseline": round(10.0 / p95_s, 1),
+        "meta": _meta_block(),
+        "stats": stats,
+        "extra_metrics": extra,
+    }
+
+
+def _small_gap_threshold() -> int:
+    from tendermint_tpu.sequencer.broadcast_reactor import (
+        SMALL_GAP_THRESHOLD,
+    )
+
+    return SMALL_GAP_THRESHOLD
 
 
 def _committee_config(n: int):

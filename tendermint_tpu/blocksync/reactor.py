@@ -372,8 +372,11 @@ class BlocksyncReactor(Reactor):
         """Save + apply one verified block (upgrade handoff raises
         CancelledError out of the pool routine)."""
         self.block_store.save_block(first, first_parts, commit)
+        # backfill priority: the revalidation's LastCommit device round
+        # rides the blocksync class, never ahead of live vote rounds
         self.state = await self.executor.apply_block(
-            self.state, first_id, first, bls_datas
+            self.state, first_id, first, bls_datas,
+            verify_klass="blocksync",
         )
         # rotation: start building the incoming set's tables now, in the
         # background, so the vote/bulk paths never pay the build inline

@@ -173,6 +173,14 @@ class ConsensusTimeoutsConfig:
     # Reactor knobs, not state-machine fields.
     vote_batch_gossip: bool = True
     vote_batch_max: int = 64
+    # gossip-plane pacing knobs (consensus/reactor.py module constants
+    # until PR 11): HasVotes possession-digest broadcast cadence, and
+    # how many batch-capable peers a freshly-accepted vote chunk
+    # eagerly relays to (0 disables eager relay; the paced pull plane
+    # still covers dissemination). Config-driven so the committee and
+    # sequencer bench families can sweep them without editing source.
+    digest_interval: float = 0.2
+    vote_forward_fanout: int = 3
 
     # every timeout/adaptive knob to_state_machine_config() carries over;
     # a field added to the state-machine ConsensusConfig MUST be listed
@@ -209,6 +217,12 @@ class ConsensusTimeoutsConfig:
                 raise ValueError(f"consensus.{f} cannot be negative")
         if self.vote_batch_max < 1:
             raise ValueError("consensus.vote_batch_max must be >= 1")
+        if self.digest_interval <= 0:
+            raise ValueError("consensus.digest_interval must be > 0")
+        if self.vote_forward_fanout < 0:
+            raise ValueError(
+                "consensus.vote_forward_fanout cannot be negative"
+            )
         if self.adaptive_timeouts:
             # the controller's own validation, surfaced at config load
             # instead of node assembly; from_knobs is the ONE mapping
@@ -230,14 +244,32 @@ class ConsensusTimeoutsConfig:
 @dataclass
 class SequencerConfig:
     """Morph sequencer-mode settings (reference sequencer key mgmt +
-    node.go:1007-1032 createSequencerComponents)."""
+    node.go:1007-1032 createSequencerComponents) plus the streaming-
+    plane knobs of the event-driven broadcast reactor
+    (sequencer/broadcast_reactor.py, PERF_ANALYSIS §17)."""
 
     block_interval: float = 3.0
     sequencer_key_file: str = ""  # secp256k1 key -> this node produces
     sequencer_addresses: str = ""  # comma-separated 0x… allowed signers
+    # follower apply/sync FALLBACK tick, seconds: the reactor wakes on
+    # block receipt / pending insertion / peer status edges, so these
+    # only bound staleness after a missed edge (the reference polls at
+    # a hard 10 s cadence — keep 10.0 to mirror it)
+    apply_interval: float = 10.0
+    sync_interval: float = 10.0
+    # catchup: missing-height requests kept in flight on the 0x51 sync
+    # channel (each response refills the window)
+    catchup_window: int = 64
 
     def validate_basic(self) -> None:
-        pass
+        if self.block_interval <= 0:
+            raise ValueError("sequencer.block_interval must be > 0")
+        if self.apply_interval <= 0 or self.sync_interval <= 0:
+            raise ValueError(
+                "sequencer.apply_interval/sync_interval must be > 0"
+            )
+        if self.catchup_window < 1:
+            raise ValueError("sequencer.catchup_window must be >= 1")
 
 
 @dataclass

@@ -164,9 +164,16 @@ class ConsensusReactor(Reactor):
         logger: Optional[Logger] = None,
         vote_batch: bool = True,
         vote_batch_max: int = VOTE_BATCH_MAX,
+        digest_interval: float = DIGEST_INTERVAL,
+        vote_forward_fanout: int = VOTE_FORWARD_FANOUT,
     ):
         super().__init__("consensus")
         self.cs = cs
+        # gossip-pacing knobs ([consensus] digest_interval /
+        # vote_forward_fanout): module constants stay the defaults, but
+        # bench sweeps and deployments drive them from config
+        self.digest_interval = float(digest_interval)
+        self.vote_forward_fanout = max(0, int(vote_forward_fanout))
         # committee-scale batched vote gossip ([consensus]
         # vote_batch_gossip): when off, this node neither advertises
         # VOTE_BATCH_CHANNEL nor sends batches — the wire behavior of
@@ -391,7 +398,7 @@ class ConsensusReactor(Reactor):
         last: dict[tuple[int, int, int], int] = {}
         try:
             while True:
-                await asyncio.sleep(DIGEST_INTERVAL)
+                await asyncio.sleep(self.digest_interval)
                 if self.switch is None:
                     continue
                 rs = cs.rs
@@ -809,13 +816,13 @@ class ConsensusReactor(Reactor):
         self, src_peer: Peer, votes: list[Vote]
     ) -> None:
         """Relay a just-accepted, pre-verified chunk to up to
-        VOTE_FORWARD_FANOUT batch-capable peers that (by our
+        `vote_forward_fanout` batch-capable peers that (by our
         bookkeeping) miss at least the committee fill floor of it.
         Terminates: every send marks the peer's bits first, the receive
         side drops verbatim-known votes from 'fresh', and sub-min
         residues are left to the paced pull plane — so a vote crosses
         each edge at most once per direction."""
-        if not votes or self.switch is None:
+        if not votes or self.switch is None or self.vote_forward_fanout <= 0:
             return
         size = self.cs.state.validators.size()
         cur_height = self.cs.rs.height
@@ -834,12 +841,12 @@ class ConsensusReactor(Reactor):
             for p in self.switch.peers.values()
             if p.id != src_peer.id and self._peer_supports_batch(p)
         ]
-        if len(candidates) > VOTE_FORWARD_FANOUT:
+        if len(candidates) > self.vote_forward_fanout:
             # rotation-randomized subset: epidemic fanout, not flood —
             # different chunks pick different successors
             start = secrets.randbelow(len(candidates))
             candidates = (candidates[start:] + candidates[:start])[
-                :VOTE_FORWARD_FANOUT
+                : self.vote_forward_fanout
             ]
         for peer in candidates:
             prs = self._peer_states.get(peer.id)

@@ -860,17 +860,30 @@ class ConsensusState:
         if rs.proposal_block is None:
             await self._sign_add_vote(VoteType.PREVOTE, b"", None)
             return
+        # pin the proposal across the off-loop validation await: the
+        # loop keeps running (that is the point — the commit-light
+        # dispatch no longer stalls it), so rs may move meanwhile
+        block = rs.proposal_block
         try:
-            self.executor.validate_block(self.state, rs.proposal_block)
-            ok = self.executor.process_proposal(self.state, rs.proposal_block)
+            await self.executor.validate_block_off_loop(self.state, block)
+            if (
+                rs.height != height
+                or rs.round != round_
+                or rs.proposal_block is not block
+            ):
+                # moved on while validating (round/height advanced, or
+                # a concurrent step swapped/cleared the proposal): the
+                # new step decides — only the pinned `block` below
+                return
+            ok = self.executor.process_proposal(self.state, block)
             if not ok:
                 raise ValueError("CheckBlockData rejected proposal")
             # batch-point consistency: a batch hash in the header must match
             # what the L2 node computes from the carried batch header
-            bh = rs.proposal_block.header.batch_hash
+            bh = block.header.batch_hash
             if bh:
                 expect = self.l2.batch_hash(
-                    rs.proposal_block.data.l2_batch_header
+                    block.data.l2_batch_header
                 )
                 if expect != bh:
                     raise ValueError("batch hash mismatch in proposal")
@@ -881,9 +894,9 @@ class ConsensusState:
                 # about L2 batch contents and the proposal is invalid.
                 # (The proposer already sealed in _create_proposal_block
                 # and stored the batch data under its block hash.)
-                if self.batch_cache.batch_data(rs.proposal_block.hash()) is None:
+                if self.batch_cache.batch_data(block.hash()) is None:
                     self.l2.calculate_batch_size_with_proposal_block(
-                        rs.proposal_block.encode(), True
+                        block.encode(), True
                     )
                     local_hash, local_header = self.l2.seal_batch()
                     if local_hash != bh:
@@ -891,15 +904,25 @@ class ConsensusState:
                             "locally sealed batch hash disagrees with proposal"
                         )
                     self.batch_cache.store_batch_data(
-                        rs.proposal_block.hash(), local_hash, local_header
+                        block.hash(), local_hash, local_header
                     )
         except ValueError as e:
+            if (
+                rs.height != height
+                or rs.round != round_
+                or rs.proposal_block is not block
+            ):
+                # the state moved during the off-loop validation await
+                # (e.g. this height committed): the failure is against
+                # a state the proposal was never meant for — don't sign
+                # anything for the round we're no longer in
+                return
             self.logger.info("prevoting nil: invalid proposal", err=repr(e))
             await self._sign_add_vote(VoteType.PREVOTE, b"", None)
             return
         await self._sign_add_vote(
             VoteType.PREVOTE,
-            rs.proposal_block.hash(),
+            block.hash(),
             rs.proposal_block_parts.header,
         )
 
@@ -971,14 +994,29 @@ class ConsensusState:
             rs.proposal_block is not None
             and rs.proposal_block.hash() == bid.hash
         ):
+            block = rs.proposal_block
             try:
-                self.executor.validate_block(self.state, rs.proposal_block)
+                await self.executor.validate_block_off_loop(
+                    self.state, block
+                )
             except ValueError as e:
+                if rs.height != height or rs.round != round_ or (
+                    rs.step > Step.PRECOMMIT
+                ) or rs.proposal_block is not block:
+                    # stale: the state advanced mid-await (e.g. the
+                    # height committed), so the block legitimately no
+                    # longer validates against it — not a +2/3-on-
+                    # invalid fault
+                    return
                 raise RuntimeError(
                     f"+2/3 prevoted an invalid block: {e}"
                 ) from e
+            if rs.height != height or rs.round != round_ or (
+                rs.step > Step.PRECOMMIT
+            ) or rs.proposal_block is not block:
+                return  # moved on while the off-loop validation ran
             rs.locked_round = round_
-            rs.locked_block = rs.proposal_block
+            rs.locked_block = block
             rs.locked_block_parts = rs.proposal_block_parts
             if self.event_bus is not None:
                 await self.event_bus.publish_lock(rs)

@@ -25,9 +25,15 @@ class MockL2Node:
         bls_batch_verifier: Optional[
             Callable[[list, bytes, list], list]
         ] = None,
+        max_block_txs: int = 0,
     ):
         self._lock = threading.Lock()
         self.txs_per_block = txs_per_block
+        # gas-limit analog for the sustained-load harness: a V2 block
+        # takes at most this many injected txs per pull, the remainder
+        # stays pending for the next block (0 = unbounded, the original
+        # drain-everything behavior)
+        self.max_block_txs = max_block_txs
         self.batch_blocks_interval = batch_blocks_interval
         self._bls_verifier = bls_verifier
         self._bls_batch_verifier = bls_batch_verifier
@@ -164,12 +170,21 @@ class MockL2Node:
 
     def seed_v2_height(self, height: int) -> None:
         """Test helper: advance the mock chain to `height` with unsigned
-        linked blocks (simulates the pre-upgrade L2 state)."""
+        linked blocks (simulates the pre-upgrade L2 state). Injected
+        pending txs are stashed across the seed: they belong to the
+        POST-upgrade blocks, and consuming them here would fork this
+        node's deterministic seed chain away from every peer's."""
         self._ensure_v2_genesis()
-        while self.v2_chain[-1].number < height:
-            parent = self.v2_chain[-1]
-            b, _ = self.request_block_data_v2(parent.hash)
-            self.apply_block_v2(b)
+        with self._lock:
+            stash, self.pending_txs = self.pending_txs, []
+        try:
+            while self.v2_chain[-1].number < height:
+                parent = self.v2_chain[-1]
+                b, _ = self.request_block_data_v2(parent.hash)
+                self.apply_block_v2(b)
+        finally:
+            with self._lock:
+                self.pending_txs = stash + self.pending_txs
 
     def request_block_data_v2(self, parent_hash: bytes):
         self._ensure_v2_genesis()
@@ -180,7 +195,11 @@ class MockL2Node:
             if parent is None:
                 raise ValueError("unknown parent hash")
             if self.pending_txs:
-                txs, self.pending_txs = self.pending_txs, []
+                cut = self.max_block_txs or len(self.pending_txs)
+                txs, self.pending_txs = (
+                    self.pending_txs[:cut],
+                    self.pending_txs[cut:],
+                )
             else:
                 txs = [
                     b"v2tx-%d-%d" % (parent.number + 1, i)

@@ -698,6 +698,60 @@ class LightServeMetrics:
         )
 
 
+class SequencerMetrics:
+    """tendermint_tpu/sequencer — the post-upgrade BlockV2 streaming
+    plane. Apply latency (receipt -> applied) is the number that says
+    whether the plane is event-driven or riding the polling fallback;
+    the fanout counters say whether a slow subscriber defers (healthy)
+    or stalls (regression) the broadcast drain."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.height = reg.gauge(
+            "sequencer_height", "Latest applied BlockV2 height"
+        )
+        self.blocks_applied = reg.counter(
+            "sequencer_blocks_applied_total", "BlockV2s applied"
+        )
+        self.blocks_broadcast = reg.counter(
+            "sequencer_blocks_broadcast_total",
+            "Origin broadcasts drained from the production queue",
+        )
+        self.apply_latency = reg.histogram(
+            "sequencer_apply_latency_seconds",
+            "Gossip/sync receipt to local apply (the event-driven plane "
+            "replaces the 10 s polling floor here)",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                     5.0, 15.0, float("inf")),
+        )
+        self.fanout_sends = reg.counter(
+            "sequencer_fanout_sends_total",
+            "Block gossip messages accepted into peer send queues",
+        )
+        self.fanout_deferred = reg.counter(
+            "sequencer_fanout_deferred_total",
+            "Fan-out sends skipped on a full 0x50 send queue and queued "
+            "for revisit (backpressure, not blocking)",
+        )
+        self.fanout_dropped = reg.counter(
+            "sequencer_fanout_dropped_total",
+            "Deferred fan-out entries dropped (revisit budget exceeded "
+            "or peer departed; the peer catches up on the sync channel)",
+        )
+        self.pending_blocks = reg.gauge(
+            "sequencer_pending_blocks", "Blocks parked in the pending cache"
+        )
+        self.catchup_requests = reg.counter(
+            "sequencer_catchup_requests_total",
+            "Missing-height requests sent on the 0x51 sync channel",
+        )
+        self.requests_expired = reg.counter(
+            "sequencer_requests_expired_total",
+            "Requested heights expired (NoBlockResponse, peer departure, "
+            "or TTL) and made re-requestable",
+        )
+
+
 class EvidenceMetrics:
     def __init__(self, reg: Optional[Registry] = None):
         reg = reg or default_registry()

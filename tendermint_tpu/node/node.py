@@ -398,6 +398,9 @@ class Node(Service):
         self.sequencer_reactor = BlockBroadcastReactor(
             self.state_v2, self.sequencer_verifier, wait_sync=True,
             logger=self.logger,
+            apply_interval=config.sequencer.apply_interval,
+            sync_interval=config.sequencer.sync_interval,
+            catchup_window=config.sequencer.catchup_window,
         )
 
         # --- consensus (node.go:460-501) ---
@@ -496,6 +499,8 @@ class Node(Service):
             logger=self.logger,
             vote_batch=config.consensus.vote_batch_gossip,
             vote_batch_max=config.consensus.vote_batch_max,
+            digest_interval=config.consensus.digest_interval,
+            vote_forward_fanout=config.consensus.vote_forward_fanout,
         )
 
         # --- blocksync (node.go:435-458) ---

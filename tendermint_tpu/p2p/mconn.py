@@ -177,6 +177,16 @@ class MConnection:
                 pass
         self._conn.close()
 
+    def queue_headroom(self, channel_id: int) -> int:
+        """Free slots in a channel's send queue — the cheap read of the
+        p2p_send_queue_* backpressure signal. 0 means a send would be
+        dropped (TrySend returns False); fan-out planes use it to
+        skip-and-revisit a congested peer instead of hammering sends."""
+        ch = self._channels.get(channel_id)
+        if ch is None or not self._running:
+            return 0
+        return max(0, ch.send_queue.maxsize - ch.send_queue.qsize())
+
     def send(self, channel_id: int, msg: bytes) -> bool:
         """Queue a message; False if the channel queue is full (TrySend)."""
         ch = self._channels.get(channel_id)

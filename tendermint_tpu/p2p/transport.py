@@ -66,6 +66,11 @@ class Peer:
 
     try_send = send
 
+    def queue_headroom(self, channel_id: int) -> int:
+        """Free send-queue slots on one channel (0 = full; see
+        MConnection.queue_headroom)."""
+        return self.mconn.queue_headroom(channel_id)
+
     # --- clock estimate (timestamped ping/pong, mconn.py) ----------------
 
     @property

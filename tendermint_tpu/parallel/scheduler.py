@@ -70,12 +70,29 @@ from ..obs import default_tracer
 # round: live consensus votes must never queue behind a blocksync/light
 # backfill flood, and serving EXTERNAL light clients (the lightserve
 # plane's shared bisection verifies) ranks below even the node's own
-# light-client work. Starvation the other way is structurally bounded —
-# every round takes whatever capacity the higher classes left
-# (consensus load is O(validators) per height, max_batch is 16k).
-CLASS_ORDER = ("consensus", "evidence", "blocksync", "light", "lightserve")
+# light-client work. `sequencer` — the post-upgrade BlockV2 stream's
+# ECDSA recover rounds (fn lane) — sits directly under consensus: after
+# the switch it IS the live chain, and pre-switch it carries no load,
+# so it never competes with live votes. Starvation the other way is
+# structurally bounded — every round takes whatever capacity the higher
+# classes left (consensus load is O(validators) per height, max_batch
+# is 16k).
+CLASS_ORDER = (
+    "consensus", "sequencer", "evidence", "blocksync", "light", "lightserve"
+)
 
 DEFAULT_MAX_BATCH = 16384
+
+# sentinel returned to submit_sync/submit_fn_sync when the scheduler
+# stopped between the caller's `running` check and the coroutine
+# actually executing on the loop: the CALLING worker thread then runs
+# the work itself. Degrading through the shared default executor here
+# (what submit/submit_fn do for direct callers) can deadlock — the
+# calling thread already HOLDS a default-executor slot, and on a small
+# pool (min(32, cpus+4); 6 on a 2-core box) every slot can be held by
+# threads waiting on exactly this degrade, so the queued fallback never
+# gets a slot.
+_NOT_RUNNING = object()
 
 
 class _Submission:
@@ -263,22 +280,44 @@ class VerifyScheduler:
         with self.metrics.queue_depth.track_inprogress(sub.n, klass=klass):
             return await fut
 
+    async def _submit_for_thread(self, items, klass):
+        """submit() for run_coroutine_threadsafe bridges: when the
+        scheduler stopped in the submit window, hand the work BACK to
+        the calling thread (see _NOT_RUNNING) instead of queueing it on
+        the shared default executor from here."""
+        if not items:
+            return np.zeros(0, dtype=bool)
+        if not self.running:
+            return _NOT_RUNNING
+        return await self._enqueue(list(items), klass, fn=None)
+
+    async def _submit_fn_for_thread(self, items, fn, klass):
+        if not items:
+            return []
+        if not self.running:
+            return _NOT_RUNNING
+        return await self._enqueue(list(items), klass, fn=fn)
+
     def submit_sync(
         self, items: list[SigItem], klass: str = "consensus"
     ) -> np.ndarray:
         """Blocking submit for worker threads (blocksync's windowed
         verify, the vote micro-batcher's executor thread). Degrades to
-        direct dispatch when the scheduler isn't running, when called on
-        an event-loop thread, or when the scheduled round fails."""
+        direct dispatch ON THE CALLING THREAD when the scheduler isn't
+        running, when called on an event-loop thread, or when the
+        scheduled round fails."""
         items = list(items)
         loop = self._loop
         if not self.running or loop is None or self._on_loop_thread():
             return np.asarray(self.verifier.verify(items))
         try:
             fut = asyncio.run_coroutine_threadsafe(
-                self.submit(items, klass), loop
+                self._submit_for_thread(items, klass), loop
             )
-            return np.asarray(fut.result())
+            res = fut.result()
+            if res is _NOT_RUNNING:
+                return np.asarray(self.verifier.verify(items))
+            return np.asarray(res)
         except Exception as e:
             self.logger.error(
                 "scheduled verify failed; direct dispatch", err=repr(e)
@@ -289,14 +328,18 @@ class VerifyScheduler:
         self, items: list, fn: Callable[[list], list],
         klass: str = "consensus",
     ):
+        items = list(items)
         loop = self._loop
         if not self.running or loop is None or self._on_loop_thread():
             return fn(items)
         try:
             fut = asyncio.run_coroutine_threadsafe(
-                self.submit_fn(items, fn, klass), loop
+                self._submit_fn_for_thread(items, fn, klass), loop
             )
-            return fut.result()
+            res = fut.result()
+            if res is _NOT_RUNNING:
+                return fn(items)
+            return res
         except Exception as e:
             self.logger.error(
                 "scheduled fn-lane verify failed; direct dispatch",

@@ -11,6 +11,7 @@ and apply.
 from .caches import BlockRingBuffer, HashSet, PeerHashSet, PendingBlockCache
 from .signer import LocalSigner, StaticSequencerVerifier
 from .state_v2 import StateV2
+from .verify import SequencerVerifyBatcher
 from .broadcast_reactor import (
     BLOCK_BROADCAST_CHANNEL,
     SEQUENCER_SYNC_CHANNEL,
@@ -24,6 +25,7 @@ __all__ = [
     "PendingBlockCache",
     "LocalSigner",
     "StaticSequencerVerifier",
+    "SequencerVerifyBatcher",
     "StateV2",
     "BlockBroadcastReactor",
     "BLOCK_BROADCAST_CHANNEL",

@@ -134,6 +134,19 @@ class PendingBlockCache:
                 longest = chain
         return longest
 
+    def remove(self, h: bytes) -> None:
+        """Drop one pending block by hash (a forged copy that failed
+        signature/apply must free its slot, or the genuine block of the
+        same hash could never re-enter — add() dedupes by hash)."""
+        block = self._blocks.pop(h, None)
+        if block is None:
+            return
+        sibs = self._by_parent.get(block.parent_hash)
+        if sibs:
+            sibs[:] = [b for b in sibs if b.hash != h]
+            if not sibs:
+                del self._by_parent[block.parent_hash]
+
     def prune_below(self, height: int) -> None:
         for h, block in list(self._blocks.items()):
             if block.number <= height:

@@ -166,12 +166,32 @@ class BlockExecutor:
 
     # --- validation -------------------------------------------------------
 
-    def validate_block(self, state: State, block: Block) -> None:
+    def validate_block(self, state: State, block: Block, verifier=None) -> None:
         """Stateful validation incl. evidence (reference ValidateBlock :207)."""
-        state.make_block_validate(block)
+        state.make_block_validate(block, verifier=verifier)
         if self._evpool:
             for ev in block.evidence:
                 self._evpool.check_evidence(ev, state)
+
+    async def validate_block_off_loop(
+        self, state: State, block: Block, klass: str = "consensus"
+    ) -> None:
+        """validate_block with its LastCommit device verify moved OFF
+        the event loop (the PR 9 follow-up): the check runs in an
+        executor thread against a scheduler-classed adapter, so a
+        proposal's commit-light dispatch coalesces with in-flight vote
+        rounds instead of stalling the consensus loop for a full device
+        round (the vote path made this move in PR 3). `klass` is the
+        caller's priority class — the live consensus path uses the
+        default, blocksync backfill passes "blocksync" so a catchup
+        flood never queues at live-vote priority. Raises exactly what
+        validate_block raises."""
+        from ..parallel.scheduler import default_dispatch
+
+        verifier = default_dispatch(klass)
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.validate_block, state, block, verifier
+        )
 
     def process_proposal(self, state: State, block: Block) -> bool:
         """CheckBlockData against the L2 node (reference ProcessProposal
@@ -188,9 +208,10 @@ class BlockExecutor:
         block_id: BlockID,
         block: Block,
         bls_datas: Optional[list[BlsData]] = None,
+        verify_klass: str = "consensus",
     ) -> State:
         """The commit pipeline (reference ApplyBlock :220-288)."""
-        self.validate_block(state, block)
+        await self.validate_block_off_loop(state, block, klass=verify_klass)
 
         abci_responses = await self._exec_block_on_app(state, block)
         fail.fail_point()  # crash between app exec and L2 delivery

@@ -88,9 +88,13 @@ class State:
             app_hash=genesis.app_hash,
         )
 
-    def make_block_validate(self, block: Block) -> None:
+    def make_block_validate(self, block: Block, verifier=None) -> None:
         """Stateful block validation (reference state/validation.go
-        validateBlock): header fields must chain from this state."""
+        validateBlock): header fields must chain from this state.
+        `verifier` routes the LastCommit signature check (a device
+        dispatch) — callers off the event loop pass a scheduler-classed
+        adapter so the dispatch coalesces instead of stalling the
+        consensus loop."""
         block.validate_basic()
         h = block.header
         if h.chain_id != self.chain_id:
@@ -127,6 +131,7 @@ class State:
                 self.last_block_id,
                 self.last_block_height,
                 block.last_commit,
+                verifier=verifier,
             )
         if h.time_ns <= self.last_block_time_ns and self.last_block_height > 0:
             raise ValueError("block time must be monotonically increasing")

@@ -14,6 +14,16 @@ from typing import Optional
 from ..crypto import secp256k1
 from ..libs import protoio as pio
 
+# process-wide count of ACTUAL wire serializations (memo misses): the
+# encode-once fan-out bench asserts one serialization per broadcast
+# block regardless of subscriber count (tests/bench read the delta)
+_SERIALIZATIONS = 0
+
+
+def serializations() -> int:
+    """Total BlockV2 cache-miss serializations this process."""
+    return _SERIALIZATIONS
+
 
 @dataclass
 class BlockV2:
@@ -32,6 +42,18 @@ class BlockV2:
     next_l1_message_index: int = 0
     hash: bytes = b"\x00" * 32
     signature: bytes = b""
+    # memoized wire encoding (the Part.encode pattern): a sequencer
+    # gossips one block to N subscriber peers and serves it again on the
+    # 0x51 sync channel — one serialization, N framed copies. Field
+    # assignment invalidates (__setattr__ below); in-place mutation of
+    # `transactions` after an encode does not, same immutability
+    # contract as Part.bytes_.
+    _encoded: Optional[bytes] = field(default=None, compare=False, repr=False)
+
+    def __setattr__(self, name, value):
+        if name != "_encoded":
+            object.__setattr__(self, "_encoded", None)
+        object.__setattr__(self, name, value)
 
     # --- SyncableBlock interface (types/block_v2.go:57-63) ----------------
 
@@ -53,6 +75,10 @@ class BlockV2:
     # --- wire (proto field numbering of seqproto.BlockV2) -------------------
 
     def encode(self) -> bytes:
+        if self._encoded is not None:
+            return self._encoded
+        global _SERIALIZATIONS
+        _SERIALIZATIONS += 1
         out = b""
         out += pio.field_bytes(1, self.parent_hash)
         out += pio.field_bytes(2, self.miner)
@@ -75,6 +101,9 @@ class BlockV2:
         out += pio.field_varint(13, self.next_l1_message_index)
         out += pio.field_bytes(14, self.hash)
         out += pio.field_bytes(15, self.signature)
+        # assign via object.__setattr__: a plain assignment would
+        # immediately invalidate the cache it is trying to fill
+        object.__setattr__(self, "_encoded", out)
         return out
 
     @classmethod

@@ -450,6 +450,30 @@ def test_bench_require_backend_fails_structured():
     assert art["ok"] is False and art["fallback"] == "none"
     assert art["kind"] == "backend_mismatch"
     assert "meta" in art  # provenance stamps the MULTICHIP family too
+    # and the sequencer_stream family honors the same contract (it is
+    # wall-clock/CPU-valid, but an operator pinning a backend must get
+    # the structured failure, never a silent CPU row)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "bench.py",
+            "--family",
+            "sequencer_stream",
+            "--require-backend",
+            "tpu",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr[-2000:]
+    art = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert art["rc"] == 1 and art["fallback"] == "none"
+    assert art["kind"] == "backend_mismatch"
+    assert art["required_backend"] == "tpu"
+    assert "meta" in art
 
 
 # --- scenario e2e on a 4-validator mesh -------------------------------------

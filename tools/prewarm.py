@@ -67,6 +67,13 @@ FAMILY_TIERS = {
     "blocksync": ("small", "big"),
     "light": ("small", "big"),
     "lightserve": ("small", "big"),
+    # the sequencer streaming plane's signature checks are host-native
+    # ECDSA recovers riding the scheduler's fn lane — no ladder verify
+    # programs are reachable, so its tier set is empty. It is still a
+    # first-class verify family: manifests record covering it, and
+    # --verify --families sequencer fails against a manifest whose
+    # recorded coverage predates the class (see check_families).
+    "sequencer": (),
 }
 
 # committee-scale bucket rungs (PERF_ANALYSIS §16): batched vote gossip
@@ -197,7 +204,8 @@ def check_families(manifest: dict, families=None) -> list[str]:
     path."""
     problems = []
     built_tiers = {e["tier"] for e in manifest.get("entries", ())}
-    for family in families or manifest.get("families", ()):
+    claimed = manifest.get("families")
+    for family in families or claimed or ():
         required = FAMILY_TIERS.get(family)
         if required is None:
             # an unknown name (operator typo in --families) must FAIL,
@@ -205,6 +213,27 @@ def check_families(manifest: dict, families=None) -> list[str]:
             problems.append(
                 f"family {family!r} is not a known verify class "
                 f"(known: {sorted(FAMILY_TIERS)})"
+            )
+            continue
+        if claimed is not None and family not in claimed:
+            # the manifest recorded its coverage and this class is not
+            # in it — a build predating the class (e.g. `sequencer`) or
+            # an explicitly partial one must fail the requirement even
+            # when the class has no reachable ladder tiers
+            problems.append(
+                f"family {family}: not covered by this manifest build "
+                f"(recorded coverage: {sorted(claimed)})"
+            )
+            continue
+        if claimed is None and not required:
+            # a family with NO reachable ladder tiers (sequencer) has
+            # no tier evidence to check — only recorded coverage can
+            # demonstrate it, so a legacy manifest without a `families`
+            # key cannot vacuously pass the requirement
+            problems.append(
+                f"family {family}: manifest records no family coverage "
+                f"and the class has no ladder tiers to check — rebuild "
+                f"with a coverage-recording prewarm"
             )
             continue
         missing = [t for t in required if t not in built_tiers]
