@@ -427,6 +427,77 @@ class LightServeConfig:
 
 
 @dataclass
+class HealthConfig:
+    """Live health plane (tendermint_tpu/obs/health.py): streaming
+    detectors over the metric/trace seams rolled into per-subsystem
+    SLO burn-rate verdicts, served by the `health`/`dump_health` RPCs
+    and the tm_health_status{subsystem=} gauges. Default on — the
+    monitor is a sampling loop plus a heartbeat task, not a hot path."""
+
+    enable: bool = True
+    # sampling cadence of the pull seams (scheduler/WAL/sequencer/
+    # lightserve/p2p), seconds
+    interval: float = 1.0
+    # event-loop lag probe cadence; lag is measured as the probe's
+    # scheduling overshoot
+    heartbeat_interval: float = 0.25
+    # multiwindow burn-rate windows (seconds): warn/critical require
+    # BOTH windows over threshold, so short confirms "still happening"
+    short_window: float = 30.0
+    long_window: float = 300.0
+    # quorum-lag anomaly: arrivals later than max(floor, margin *
+    # baseline_p95) behind the round's first vote are bad events. The
+    # first 32 samples are learning-only — gossip-tick trickle gives
+    # even a clean committee a genuine arrival spread (~100 ms p95 on
+    # the in-proc harness), so the baseline must exist before anything
+    # is judged against it; margin 2x that learned tail is the anomaly
+    # bar
+    quorum_lag_floor: float = 0.025
+    quorum_lag_margin: float = 2.0
+    # verify-scheduler queue depth that counts as saturated when the
+    # sampling interval also shows full/no dispatch progress
+    scheduler_depth_floor: int = 256
+    # WAL fsync drift: interval-mean latency beyond this multiple of
+    # the learned good-sample median flags
+    fsync_drift_factor: float = 4.0
+    # sequencer receipt->applied SLO target (PR 10 measured 96 ms p95;
+    # snapped up to the nearest apply-latency histogram bucket, 0.1 s)
+    sequencer_apply_target: float = 0.1
+    # lightserve proof-cache hit-rate floor (the SLO objective)
+    cache_hit_floor: float = 0.9
+    # event-loop lag above this is a bad event (PR 9: loop-bound nets)
+    loop_lag_warn: float = 0.05
+    # stalled-round ceiling = this factor x the static round-0 timeout
+    # schedule (propose + prevote + precommit + commit waits)
+    stall_factor: float = 3.0
+
+    def validate_basic(self) -> None:
+        if self.interval <= 0 or self.heartbeat_interval <= 0:
+            raise ValueError(
+                "health.interval/heartbeat_interval must be > 0"
+            )
+        if not (0 < self.short_window <= self.long_window):
+            raise ValueError(
+                "health windows must satisfy 0 < short_window <= "
+                "long_window"
+            )
+        if not (0.0 < self.cache_hit_floor < 1.0):
+            raise ValueError("health.cache_hit_floor must be in (0, 1)")
+        for f in (
+            "quorum_lag_floor",
+            "quorum_lag_margin",
+            "fsync_drift_factor",
+            "sequencer_apply_target",
+            "loop_lag_warn",
+            "stall_factor",
+        ):
+            if getattr(self, f) <= 0:
+                raise ValueError(f"health.{f} must be > 0")
+        if self.scheduler_depth_floor < 1:
+            raise ValueError("health.scheduler_depth_floor must be >= 1")
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # kv | null
 
@@ -466,6 +537,7 @@ _SECTIONS = {
     "scheduler": SchedulerConfig,
     "commit_pipeline": CommitPipelineConfig,
     "lightserve": LightServeConfig,
+    "health": HealthConfig,
     "tx_index": TxIndexConfig,
     "instrumentation": InstrumentationConfig,
 }
@@ -489,6 +561,7 @@ class Config:
         default_factory=CommitPipelineConfig
     )
     lightserve: LightServeConfig = field(default_factory=LightServeConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig

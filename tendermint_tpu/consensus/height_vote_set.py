@@ -34,6 +34,7 @@ class HeightVoteSet:
         tracer=None,
         metrics=None,
         pacing=None,
+        health=None,
     ):
         self.chain_id = chain_id
         self.height = height
@@ -46,6 +47,9 @@ class HeightVoteSet:
         # the adaptive timeout controllers see every sample even with
         # metrics/tracing off
         self.pacing = pacing
+        # obs/health.HealthMonitor: the quorum-lag anomaly detector
+        # rides the same synchronous accept-path feed as pacing
+        self.health = health
         self._rounds: dict[int, dict[int, VoteSet]] = {}
         self._peer_catchup_rounds: dict[str, list[int]] = {}
         # (round, type) -> perf_counter of the first accepted vote; lag
@@ -121,7 +125,13 @@ class HeightVoteSet:
         tracer = self.tracer
         metrics = self.metrics
         pacing = self.pacing
-        if pacing is None and metrics is None and not tracer.enabled:
+        health = self.health
+        if (
+            pacing is None
+            and health is None
+            and metrics is None
+            and not tracer.enabled
+        ):
             return
         now = time.perf_counter()
         key = (vote.round, vote.type)
@@ -137,6 +147,8 @@ class HeightVoteSet:
                     )
             else:
                 pacing.observe_vote_arrival(vote.type, lag)
+        if health is not None and not had_quorum:
+            health.observe_vote_arrival(vote.type, lag)
         if metrics is not None:
             metrics.vote_arrival_lag.observe(lag, type=tname)
         if tracer.enabled:

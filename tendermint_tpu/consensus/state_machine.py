@@ -192,6 +192,7 @@ class ConsensusState:
         now_ns: Callable[[], int] = time.time_ns,
         commit_pipeline=None,
         pacing=None,
+        health=None,
     ):
         self.config = config
         self.executor = executor
@@ -222,6 +223,11 @@ class ConsensusState:
                 config, metrics=self.metrics, tracer=self.tracer
             )
         self.pacing = pacing
+        # obs/health.HealthMonitor (or None): fed round advances and
+        # height commits like the pacing controller, plus per-vote
+        # arrival lags via HeightVoteSet — the live health plane's
+        # consensus push seam
+        self.health = health
         self._last_commit_walltime = 0.0
         # (step_name, t0, height, round) of the step in progress — the
         # flight recorder's per-step seam: each _new_step closes the
@@ -569,6 +575,8 @@ class ConsensusState:
             )
             if self.pacing is not None:
                 self.pacing.on_round_advance(round_)
+            if self.health is not None:
+                self.health.observe_round_advance(height, round_)
         if self.metrics is not None:
             self.metrics.round_gauge.set(round_)
         rs.round = round_
@@ -1231,6 +1239,10 @@ class ConsensusState:
             self.pacing.on_height_committed(
                 block.header.height, self.rs.round
             )
+        if self.health is not None:
+            self.health.observe_height_committed(
+                block.header.height, self.rs.round
+            )
         if self.metrics is not None:
             self.metrics.commit_seconds.observe(
                 time.perf_counter() - t_commit
@@ -1376,6 +1388,7 @@ class ConsensusState:
             tracer=self.tracer,
             metrics=self.metrics,
             pacing=self.pacing,
+            health=self.health,
         )
         rs.commit_round = -1
         rs.last_commit = last_precommits

@@ -132,6 +132,13 @@ class Counter:
         key = tuple(labels.get(k, "") for k in self.label_names)
         return self._values.get(key, 0.0)
 
+    def total(self) -> float:
+        """Sum across every label series (the health monitor reads a
+        labeled gauge family — e.g. verify_queue_depth{klass=} — as one
+        scalar)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def render(self) -> list[str]:
         out = [
             f"# HELP {self.name} {self.help}",
@@ -256,6 +263,29 @@ class Histogram:
         s = self._series.get(key)
         return s.total if s is not None else 0
 
+    def series(self, **labels) -> dict:
+        """Snapshot of one label series: cumulative bucket counts, sum,
+        total. The health monitor (obs/health.py) reads interval DELTAS
+        of these to turn a histogram into an SLO event stream (fraction
+        of observations above a bucket boundary) without a per-sample
+        push seam."""
+        key = tuple(labels.get(k, "") for k in self.label_names)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return {
+                    "buckets": self.buckets,
+                    "counts": [0] * len(self.buckets),
+                    "count": 0,
+                    "sum": 0.0,
+                }
+            return {
+                "buckets": self.buckets,
+                "counts": list(s.counts),
+                "count": s.total,
+                "sum": s.sum,
+            }
+
     def total_count(self) -> int:
         """Observation count across ALL label series."""
         with self._lock:
@@ -306,20 +336,43 @@ class Registry:
         self.namespace = namespace
         self._metrics: dict[str, object] = {}
         self._lock = threading.Lock()
+        # render-time refresh hooks: process-level gauges (RSS, fds,
+        # threads) are point-in-time reads, so they refresh at scrape
+        # instead of on a sampling loop (the prometheus-client
+        # collector pattern). A raising collector is dropped from the
+        # render, never propagated — /metrics must not 500 because
+        # /proc grew a new format.
+        self._collectors: list = []
 
-    def counter(self, name, help_="", labels=()) -> Counter:
-        return self._get(name, Counter, lambda n: Counter(n, help_, labels))
+    def add_collector(self, fn) -> None:
+        """Register fn() to run at the start of every render()."""
+        with self._lock:
+            self._collectors.append(fn)
 
-    def gauge(self, name, help_="", labels=()) -> Gauge:
-        return self._get(name, Gauge, lambda n: Gauge(n, help_, labels))
-
-    def histogram(self, name, help_="", buckets=None, labels=()) -> Histogram:
+    def counter(self, name, help_="", labels=(), raw=False) -> Counter:
         return self._get(
-            name, Histogram, lambda n: Histogram(n, help_, buckets, labels)
+            name, Counter, lambda n: Counter(n, help_, labels), raw=raw
         )
 
-    def _get(self, name, kind, factory):
-        full = f"{self.namespace}_{name}"
+    def gauge(self, name, help_="", labels=(), raw=False) -> Gauge:
+        return self._get(
+            name, Gauge, lambda n: Gauge(n, help_, labels), raw=raw
+        )
+
+    def histogram(
+        self, name, help_="", buckets=None, labels=(), raw=False
+    ) -> Histogram:
+        return self._get(
+            name, Histogram, lambda n: Histogram(n, help_, buckets, labels),
+            raw=raw,
+        )
+
+    def _get(self, name, kind, factory, raw=False):
+        # raw=True skips the namespace prefix: cross-ecosystem
+        # conventional names (process_*, tm_health_status) must render
+        # verbatim or dashboards/alert rules built against the
+        # convention miss them
+        full = name if raw else f"{self.namespace}_{name}"
         with self._lock:
             m = self._metrics.get(full)
             if m is None:
@@ -338,6 +391,12 @@ class Registry:
     def render(self) -> str:
         with self._lock:
             metrics = list(self._metrics.values())
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass
         lines = []
         for m in metrics:
             lines.extend(m.render())
@@ -750,6 +809,97 @@ class SequencerMetrics:
             "Requested heights expired (NoBlockResponse, peer departure, "
             "or TTL) and made re-requestable",
         )
+
+
+class HealthMetrics:
+    """tendermint_tpu/obs/health.py — the live health plane's verdict
+    surface. Raw names (no namespace prefix): `tm_health_status` and
+    `tm_slo_burn_rate` are the contract alert rules key on."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.status = reg.gauge(
+            "tm_health_status",
+            "Per-subsystem health verdict: 0 = ok, 1 = warn, 2 = critical",
+            ("subsystem",),
+            raw=True,
+        )
+        self.burn_rate = reg.gauge(
+            "tm_slo_burn_rate",
+            "Long-window error-budget burn rate per SLO (1.0 = burning "
+            "exactly the budget; sustained > 1 exhausts it)",
+            ("slo",),
+            raw=True,
+        )
+        self.incidents = reg.counter(
+            "tm_health_incidents_total",
+            "Health-detector verdict transitions (any direction)",
+            ("subsystem",),
+            raw=True,
+        )
+
+
+class ProcessMetrics:
+    """Process-level runtime gauges (prometheus process_* conventions)
+    plus the event-loop-lag histogram fed by the health monitor's
+    heartbeat probe. The gauges refresh at scrape time via a registry
+    collector — /proc/self reads on Linux, best-effort elsewhere."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.rss_bytes = reg.gauge(
+            "process_resident_memory_bytes",
+            "Resident set size of this process",
+            raw=True,
+        )
+        self.open_fds = reg.gauge(
+            "process_open_fds",
+            "Open file descriptors held by this process",
+            raw=True,
+        )
+        self.threads = reg.gauge(
+            "process_threads", "Live threads in this process", raw=True
+        )
+        self.event_loop_lag = reg.histogram(
+            "tm_event_loop_lag_seconds",
+            "Scheduling overshoot of the health monitor's monotonic "
+            "heartbeat task (how late the event loop runs a due "
+            "callback; the PR 9 event-loop-bound regime made visible)",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, float("inf")),
+            raw=True,
+        )
+        reg.add_collector(self.collect)
+
+    def collect(self) -> None:
+        """Refresh the point-in-time gauges (called at render)."""
+        self.threads.set(threading.active_count())
+        try:
+            import os as _os
+
+            self.open_fds.set(len(_os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            import resource as _resource
+
+            self.rss_bytes.set(pages * _resource.getpagesize())
+        except (OSError, ValueError, ImportError, IndexError):
+            try:
+                import resource as _resource
+                import sys as _sys
+
+                # ru_maxrss is KiB on Linux but bytes on macOS; a peak,
+                # not current — the fallback when /proc is unavailable
+                scale = 1 if _sys.platform == "darwin" else 1024
+                self.rss_bytes.set(
+                    _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss
+                    * scale
+                )
+            except Exception:
+                pass
 
 
 class EvidenceMetrics:

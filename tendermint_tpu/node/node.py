@@ -263,6 +263,38 @@ class Node(Service):
         )
         consensus_metrics = ConsensusMetrics(self.metrics_registry)
 
+        # --- live health plane (obs/health.py): streaming detectors
+        # over the seams below; built BEFORE consensus so the arrival-
+        # lag/commit push feeds wire straight in. Pull seams bind after
+        # their owners exist; the sampling loop starts in on_start.
+        self.health_monitor = None
+        if config.health.enable:
+            from ..libs.metrics import (
+                HealthMetrics,
+                ProcessMetrics,
+                default_metrics,
+            )
+
+            # the static round-0 schedule is the stall ceiling's base:
+            # adaptive pacing only ever tightens BELOW it
+            static_round0 = (
+                config.consensus.timeout_propose
+                + config.consensus.timeout_prevote
+                + config.consensus.timeout_precommit
+                + config.consensus.timeout_commit
+            )
+            self.health_monitor = obs.HealthMonitor.from_config(
+                config.health,
+                stall_ceiling_s=config.health.stall_factor * static_round0,
+                tracer=self.tracer,
+                metrics=default_metrics(HealthMetrics),
+                process_metrics=default_metrics(ProcessMetrics),
+                logger=self.logger,
+            )
+            self.health_monitor.bind_wal(
+                consensus_metrics.wal_fsync_seconds
+            )
+
         self.state_store = StateStore(make_kv("state"))
         if config.commit_pipeline.enable:
             # write-behind persistence: saves ride a worker thread
@@ -360,6 +392,10 @@ class Node(Service):
                 dedup_window_ns=int(config.lightserve.dedup_window * 1e9),
                 logger=self.logger,
             )
+            if self.health_monitor is not None:
+                self.health_monitor.bind_lightserve(
+                    self.lightserve.cache.metrics
+                )
 
         # --- executor (node.go:883) ---
         self.block_executor = BlockExecutor(
@@ -401,7 +437,12 @@ class Node(Service):
             apply_interval=config.sequencer.apply_interval,
             sync_interval=config.sequencer.sync_interval,
             catchup_window=config.sequencer.catchup_window,
+            tracer=self.tracer,
         )
+        if self.health_monitor is not None:
+            self.health_monitor.bind_sequencer(
+                self.sequencer_reactor.metrics.apply_latency
+            )
 
         # --- consensus (node.go:460-501) ---
         # unified verification dispatch scheduler: every subsystem's
@@ -431,6 +472,10 @@ class Node(Service):
                     logger=self.logger,
                 )
             )
+            if self.health_monitor is not None:
+                self.health_monitor.bind_scheduler(
+                    self.verify_scheduler.metrics
+                )
         # commit pipeline (consensus/commit_pipeline.py): group-commit
         # WAL + write-behind block store + background apply. All three
         # are wired together — replay semantics are designed for the
@@ -493,6 +538,7 @@ class Node(Service):
             logger=self.logger,
             commit_pipeline=self.commit_pipeline,
             pacing=self.pacing,
+            health=self.health_monitor,
         )
         self.consensus_reactor = ConsensusReactor(
             self.consensus,
@@ -554,6 +600,8 @@ class Node(Service):
                 config.addr_book_file, our_id=self.node_key.id
             )
             sw.add_reactor("pex", PEXReactor(self.addr_book))
+        if self.health_monitor is not None:
+            self.health_monitor.bind_switch(sw)
 
         # --- rpc + metrics ---
         self.rpc_server = None
@@ -621,6 +669,8 @@ class Node(Service):
         # default_dispatch degrades to direct dispatch — still correct)
         if self.verify_scheduler is not None:
             await self.verify_scheduler.start()
+        if self.health_monitor is not None:
+            await self.health_monitor.start()
         await self.proxy_app.start()
         if self.indexer_service is not None:
             await self.indexer_service.start()
@@ -794,6 +844,8 @@ class Node(Service):
                 ev.set()
             if self.verify_scheduler is not None:
                 await self.verify_scheduler.stop()
+            if self.health_monitor is not None:
+                await self.health_monitor.stop()
             raise
 
     async def _run_statesync(self) -> None:
@@ -872,6 +924,8 @@ class Node(Service):
         # then later submissions degrade to direct dispatch
         if self.verify_scheduler is not None:
             await self.verify_scheduler.stop()
+        if self.health_monitor is not None:
+            await self.health_monitor.stop()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self.metrics_server is not None:

@@ -11,8 +11,17 @@ from .cluster import (
     normalize_dump,
     report_text,
 )
+from .health import (
+    CRITICAL,
+    OK,
+    VERDICT_NAMES,
+    WARN,
+    BurnRateSLO,
+    HealthMonitor,
+)
 from .quantile import StreamingQuantile
 from .report import (
+    FAMILY_WALL_SPANS,
     ascii_timeline,
     attribution,
     attribution_table,
@@ -30,7 +39,14 @@ from .tracer import (
 )
 
 __all__ = [
+    "CRITICAL",
     "DEFAULT_RING_SIZE",
+    "FAMILY_WALL_SPANS",
+    "OK",
+    "VERDICT_NAMES",
+    "WARN",
+    "BurnRateSLO",
+    "HealthMonitor",
     "SpanRecord",
     "StreamingQuantile",
     "Tracer",

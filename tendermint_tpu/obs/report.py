@@ -72,26 +72,54 @@ def attribution(records: list[dict]) -> dict:
 
 
 # wall-per-height attribution buckets (tools/pacing_report.py + the
-# consensus_pacing bench family). The cs.* step spans partition a
-# height's wall clock by construction (each closes at the transition to
-# the next), so bucketing THEM — not the nested exec/store spans, which
+# consensus_pacing/committee_scale/sequencer_stream bench families).
+# For the consensus family the cs.* step spans partition a height's
+# wall clock by construction (each closes at the transition to the
+# next), so bucketing THEM — not the nested exec/store spans, which
 # would double-count — splits wall time into:
 #   floor   — steps that exist to wait out a timeout window
 #   gossip  — steps spent waiting on peers (proposal parts, votes)
 #   compute — the decision/finalize step itself
+# The sequencer family maps the post-upgrade streaming plane's seq.*
+# spans (broadcast_reactor.py) the same way: parked fallback waits are
+# the floor, catchup/fan-out the gossip bucket, apply/verify compute.
+# committee_scale nets run the same cs.* state machine, so they share
+# the consensus classification.
 WALL_FLOOR_SPANS = frozenset(
     {"cs.new_height", "cs.prevote_wait", "cs.precommit_wait"}
 )
 WALL_GOSSIP_SPANS = frozenset({"cs.propose", "cs.prevote", "cs.precommit"})
 WALL_COMPUTE_SPANS = frozenset({"cs.commit", "cs.new_round"})
 
+# family name -> (floor, gossip, compute) span sets. "consensus" also
+# serves the committee_scale bench family; "sequencer" covers the
+# BlockV2 streaming plane (heights there are V2/L2 heights).
+FAMILY_WALL_SPANS: dict[str, tuple[frozenset, frozenset, frozenset]] = {
+    "consensus": (WALL_FLOOR_SPANS, WALL_GOSSIP_SPANS, WALL_COMPUTE_SPANS),
+    "sequencer": (
+        frozenset({"seq.park"}),
+        frozenset({"seq.broadcast", "seq.sync_gap"}),
+        frozenset({"seq.apply"}),
+    ),
+}
 
-def wall_attribution(records: list[dict], n_heights: int = 64) -> dict:
+
+def wall_attribution(
+    records: list[dict], n_heights: int = 64, family: str = "consensus"
+) -> dict:
     """Per-height wall-clock attribution: how much of each height went
     to the timeout floor vs gossip waits vs compute, from one node's
-    trace records (SpanRecord.to_json dicts). `other` is the residue of
+    trace records (SpanRecord.to_json dicts). `family` selects the span
+    classification (FAMILY_WALL_SPANS); `other` is the residue of
     the height window not covered by step spans (ring-boundary effects,
     records from other subsystems widening the window)."""
+    try:
+        floor_spans, gossip_spans, compute_spans = FAMILY_WALL_SPANS[family]
+    except KeyError:
+        raise ValueError(
+            f"unknown wall-attribution family {family!r}; known: "
+            f"{sorted(FAMILY_WALL_SPANS)}"
+        ) from None
     recs = [SpanRecord.from_json(r) for r in records]
     flight = flight_snapshot(recs, n_heights)
     heights: dict[int, dict] = {}
@@ -104,11 +132,11 @@ def wall_attribution(records: list[dict], n_heights: int = 64) -> dict:
             if r["kind"] != "span":
                 continue
             name = r["name"]
-            if name in WALL_FLOOR_SPANS:
+            if name in floor_spans:
                 buckets["floor"] += r.get("dur", 0.0)
-            elif name in WALL_GOSSIP_SPANS:
+            elif name in gossip_spans:
                 buckets["gossip"] += r.get("dur", 0.0)
-            elif name in WALL_COMPUTE_SPANS:
+            elif name in compute_spans:
                 buckets["compute"] += r.get("dur", 0.0)
         covered = sum(buckets.values())
         heights[h] = {

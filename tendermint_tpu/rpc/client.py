@@ -44,7 +44,15 @@ class _NamedRoutes:
         return await self.call("status")
 
     async def health(self):
+        """Liveness + monitor verdict: {node_id, latest_block_height,
+        catching_up, monitored, status} (rpc/core.py health — no longer
+        the reference's empty dict)."""
         return await self.call("health")
+
+    async def dump_health(self):
+        """Full health-plane dump: per-subsystem detector SLO state +
+        recent incidents (obs/health.HealthMonitor.verdict())."""
+        return await self.call("dump_health")
 
     async def net_info(self):
         return await self.call("net_info")

@@ -92,6 +92,7 @@ class RPCCore:
                 else {}
             ),
             "dump_traces": self.dump_traces,
+            "dump_health": self.dump_health,
             "consensus_params": self.consensus_params,
             "tx": self.tx,
             "tx_search": self.tx_search,
@@ -117,7 +118,40 @@ class RPCCore:
     # --- handlers ------------------------------------------------------------
 
     def health(self) -> dict:
-        return {}
+        """Liveness + health verdict (the reference's `health` returns
+        `{}`; readiness tooling needs the verdict, not just an open
+        socket). `status` is the monitor roll-up — "ok" when the live
+        health plane is disabled, so probes against a minimal node
+        don't read "disabled" as unhealthy; `monitored` disambiguates."""
+        from ..obs.health import VERDICT_NAMES
+
+        n = self.node
+        monitor = getattr(n, "health_monitor", None)
+        bs = n.block_store
+        return {
+            "node_id": getattr(getattr(n, "node_key", None), "id", ""),
+            "latest_block_height": bs.height,
+            "catching_up": not (
+                n.consensus.is_running or _seq_started(n)
+            ),
+            "monitored": monitor is not None,
+            "status": (
+                VERDICT_NAMES[monitor.status()]
+                if monitor is not None
+                else "ok"
+            ),
+        }
+
+    def dump_health(self) -> dict:
+        """The full health-plane verdict: per-subsystem/per-detector
+        SLO burn-rate state + the recent incident log (the structured
+        form of the `health.incident` events in dump_traces)."""
+        monitor = getattr(self.node, "health_monitor", None)
+        if monitor is None:
+            return {"enabled": False}
+        out = monitor.verdict()
+        out["enabled"] = True
+        return out
 
     def status(self) -> dict:
         n = self.node

@@ -39,6 +39,7 @@ from typing import Optional
 from ..libs import protoio as pio
 from ..libs.log import Logger
 from ..libs.metrics import SequencerMetrics, default_metrics
+from ..obs import default_tracer
 from ..p2p.mconn import ChannelDescriptor
 from ..p2p.switch import Reactor
 from ..p2p.transport import Peer
@@ -115,6 +116,7 @@ class BlockBroadcastReactor(Reactor):
         sync_interval: float = SYNC_INTERVAL,
         catchup_window: int = CATCHUP_WINDOW,
         metrics: Optional[SequencerMetrics] = None,
+        tracer=None,
     ):
         super().__init__("BlockBroadcast")
         self.state_v2 = state_v2
@@ -139,6 +141,12 @@ class BlockBroadcastReactor(Reactor):
             module="broadcastReactor"
         )
         self.metrics = metrics or default_metrics(SequencerMetrics)
+        # seq.* spans: park (floor) / broadcast + sync_gap (gossip) /
+        # apply (compute) — the sequencer family's wall-attribution
+        # seam (obs.report.FAMILY_WALL_SPANS["sequencer"]). Heights on
+        # these spans are V2 (L2) heights. is-None check: an empty
+        # Tracer is falsy (it has __len__)
+        self.tracer = default_tracer() if tracer is None else tracer
         # fallback tick intervals ([sequencer] apply_interval /
         # sync_interval): the event-driven wakeups below do the real
         # pacing; these only bound how stale a missed edge can get
@@ -310,11 +318,22 @@ class BlockBroadcastReactor(Reactor):
         configured intervals remain only as a fallback tick."""
         fallback = max(0.01, min(self.apply_interval, self.sync_interval))
         while True:
+            t_park = time.perf_counter()
             try:
                 await asyncio.wait_for(self._wakeup.wait(), timeout=fallback)
             except asyncio.TimeoutError:
                 pass
             self._wakeup.clear()
+            if self.tracer.enabled:
+                # the parked wait is the streaming plane's "floor":
+                # event-driven wakeups keep it at the inter-block gap,
+                # the polled design pinned it at the fallback tick
+                self.tracer.add_span(
+                    "seq.park",
+                    t_park,
+                    time.perf_counter() - t_park,
+                    height=self.state_v2.latest_height() + 1,
+                )
             try:
                 await self.try_apply_from_cache()
                 await self.check_sync_gap()
@@ -446,7 +465,16 @@ class BlockBroadcastReactor(Reactor):
         max_peer_height = max(self.peer_heights.values(), default=0)
         if max_peer_height - local_height <= SMALL_GAP_THRESHOLD:
             return
+        t0 = time.perf_counter()
         await self._request_missing_blocks(local_height + 1, max_peer_height)
+        if self.tracer.enabled:
+            self.tracer.add_span(
+                "seq.sync_gap",
+                t0,
+                time.perf_counter() - t0,
+                height=local_height + 1,
+                behind=max_peer_height - local_height,
+            )
 
     async def _request_missing_blocks(self, start: int, end: int) -> None:
         peers = list(self.switch.peers.values()) if self.switch else []
@@ -492,12 +520,20 @@ class BlockBroadcastReactor(Reactor):
     async def apply_block(self, block: BlockV2, verify_sig: bool) -> None:
         """Verify + apply atomically (:389-420)."""
         async with self._apply_lock:
+            t0 = time.perf_counter()
             if verify_sig and not self._verify_signature(block):
                 raise ErrInvalidSignature(str(block.number))
             current = self.state_v2.latest_block
             if current is not None and block.parent_hash != current.hash:
                 raise ValueError("parent mismatch")
             await self.state_v2.apply_block(block)
+            if self.tracer.enabled:
+                self.tracer.add_span(
+                    "seq.apply",
+                    t0,
+                    time.perf_counter() - t0,
+                    height=block.number,
+                )
             self.recent_blocks.add(block)
             self._advertise_height(block.number)
             self.metrics.blocks_applied.inc()
@@ -532,6 +568,8 @@ class BlockBroadcastReactor(Reactor):
         send. Congested peers defer instead of dropping or stalling."""
         if self.switch is None:
             return
+        t0 = time.perf_counter()
+        sends = 0
         msg = None  # framed lazily: zero eligible peers = zero encodes
         for peer in list(self.switch.peers.values()):
             if peer.id == from_peer:
@@ -541,6 +579,15 @@ class BlockBroadcastReactor(Reactor):
             if msg is None:
                 msg = _enc(_BLOCK_RESPONSE_V2, block=block)
             self._send_or_defer(peer, block, msg)
+            sends += 1
+        if sends and self.tracer.enabled:
+            self.tracer.add_span(
+                "seq.broadcast",
+                t0,
+                time.perf_counter() - t0,
+                height=block.number,
+                peers=sends,
+            )
 
     def _send_or_defer(
         self,

@@ -62,6 +62,7 @@ def build_chaos_handles(
     config=None,
     vote_batch: bool = True,
     verifier_factory=None,
+    health_factory=None,
 ) -> list[NodeHandle]:
     """n validator NodeHandles (not yet listening/started).
 
@@ -73,7 +74,11 @@ def build_chaos_handles(
     key of validator index i in the sorted set). `config` overrides the
     per-node ConsensusConfig (adaptive-pacing scenarios). `vote_batch`
     False builds legacy one-vote-per-tick reactors (the committee_scale
-    bench's baseline variant).
+    bench's baseline variant). `health_factory(name, tracer) ->
+    HealthMonitor` gives each node a live health plane wired to the
+    consensus push seams (vote arrival lags, height commits); the
+    monitor rides `cs.health`, and its incidents land in that node's
+    tracer ring so `node_dump` artifacts carry verdicts.
 
     Setup is O(n): per-node work touches only that node's keys/stores,
     and topology cost is deferred to start_mesh's peer_degree."""
@@ -86,6 +91,9 @@ def build_chaos_handles(
     handles: list[NodeHandle] = []
     for i, pv in enumerate(pvs):
         tracer = tracer_factory(f"n{i}") if tracer_factory else None
+        health = (
+            health_factory(f"n{i}", tracer) if health_factory else None
+        )
         cs, app, l2, bs, ss = make_node(
             vs,
             pv,
@@ -93,6 +101,7 @@ def build_chaos_handles(
             tracer=tracer,
             config=config,
             verifier=verifier_factory() if verifier_factory else None,
+            health=health,
         )
         nk = NodeKey.generate()
         transport, sw = _wire_node(

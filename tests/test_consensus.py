@@ -40,6 +40,7 @@ def make_node(
     metrics=None,
     tracer=None,
     verifier=None,
+    health=None,
 ):
     l2 = l2 or MockL2Node()
     app = KVStoreApplication()
@@ -61,6 +62,7 @@ def make_node(
         metrics=metrics,
         tracer=tracer,
         verifier=verifier,
+        health=health,
     )
     return cs, app, l2, block_store, state_store
 

@@ -1,0 +1,1026 @@
+"""Live health plane (tendermint_tpu/obs/health.py) + bench-trend gate
+(tools/bench_trend.py).
+
+Three layers, mirroring the PR 7 pacing suite:
+
+- deterministic detector/SLO units on synthetic timestamped streams —
+  no clock reads anywhere: every feed and every verdict passes an
+  explicit `t`, so two monitors fed the same stream are bit-identical;
+
+- monitor-level wiring: pull-seam sampling over REAL libs.metrics
+  objects (histogram-delta -> SLO event stream), incident emission into
+  the tracer ring, tm_health_status / tm_slo_burn_rate gauge export,
+  and the verdict document the health/dump_health RPCs serve;
+
+- the chaos e2e (marked chaos, quick tier): a 50 ms straggler link on
+  the PR 5 weighted-quorum topology must flip the victim's quorum-lag
+  detector to warn — and only consensus-plane detectors — within K=10
+  heights, with the `health.incident` record landing in the node's
+  dump_traces ring and zero false-critical on the clean phase;
+
+plus the bench-trajectory regression gate: unit tests of the backend
+partition / direction / gate math, and CLI smoke over the checked-in
+BENCH_r01–r11 artifacts (exit 0; the honest-CPU rows sit at ~3% of the
+r02/r03 TPU captures and must NOT flag) and over a synthetic
+20%-regressed row on a matching backend (exit non-zero).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu import obs
+from tendermint_tpu.libs.metrics import (
+    Counter,
+    HealthMetrics,
+    Histogram,
+    Registry,
+    SchedulerMetrics,
+)
+from tendermint_tpu.obs.health import (
+    CRITICAL,
+    OK,
+    WARN,
+    BurnRateSLO,
+    EventLoopLagDetector,
+    HealthMonitor,
+    LatencyDriftDetector,
+    PeerFlapDetector,
+    QuorumLagDetector,
+    RoundChurnDetector,
+    SchedulerSaturationDetector,
+    StalledRoundDetector,
+)
+
+pytestmark = pytest.mark.health
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _slo(objective=0.9, short=30.0, long=300.0, **kw):
+    return BurnRateSLO(
+        "t", objective=objective, short_window=short, long_window=long, **kw
+    )
+
+
+# --- burn-rate window math --------------------------------------------------
+
+
+def test_burn_rate_multiwindow_math():
+    slo = _slo(objective=0.9, min_events=4)
+    # 10 good events: zero burn, ok
+    for i in range(10):
+        slo.observe(float(i), bad=0)
+    assert slo.burn(10.0) == 0.0
+    assert slo.verdict(10.0) == OK
+    # 3 bad of the next 10: 3/20 = 0.15 bad fraction over a 10% budget
+    # -> burn 1.5 in both windows -> warn, below the 6x critical gate
+    for i in range(10, 20):
+        slo.observe(float(i), bad=1 if i % 3 == 0 else 0)
+    t = 20.0
+    assert slo.burn(t) == pytest.approx((3 / 20) / 0.1)
+    assert slo.verdict(t) == WARN
+
+
+def test_burn_rate_critical_requires_both_windows():
+    slo = _slo(objective=0.9, min_events=4)
+    # an all-bad burst: burn 10x in both windows -> critical
+    for i in range(8):
+        slo.observe(float(i), bad=1)
+    assert slo.verdict(8.0) == CRITICAL
+    # 40 s later the short window holds no events (burn 0) while the
+    # long window still carries the burst: a recovered incident
+    # un-pages as the short window drains
+    t = 45.0
+    assert slo.burn(t, slo.long_window) > 1.0
+    assert slo.burn(t, slo.short_window) == 0.0
+    assert slo.verdict(t) == OK
+
+
+def test_burn_rate_min_events_and_prune():
+    slo = _slo(objective=0.9, min_events=4)
+    for i in range(3):
+        slo.observe(float(i), bad=1)
+    # under min_events the verdict stays ok no matter the burn
+    assert slo.verdict(3.0) == OK
+    slo.observe(3.0, bad=1)
+    assert slo.verdict(3.5) == CRITICAL
+    # everything ages past the long window -> pruned -> ok again
+    assert slo.verdict(400.0) == OK
+    assert len(slo._events) == 0
+
+
+def test_burn_rate_validates_params():
+    with pytest.raises(ValueError):
+        BurnRateSLO("x", objective=1.0)
+    with pytest.raises(ValueError):
+        BurnRateSLO("x", short_window=60.0, long_window=30.0)
+
+
+# --- detectors on synthetic streams ----------------------------------------
+
+
+def test_round_churn_detector():
+    det = RoundChurnDetector(_slo(objective=0.9))
+    for i in range(10):
+        det.observe_height(float(i), round_=0)
+    assert det.verdict(10.0) == OK
+    # 2 churned heights in the next 10 -> burn 2x -> warn
+    for i in range(10, 20):
+        det.observe_height(float(i), round_=1 if i < 12 else 0)
+    assert det.verdict(20.0) == WARN
+    assert det.last_value == 0.0  # last height committed at round 0
+
+
+def test_round_churn_sustained_goes_critical():
+    det = RoundChurnDetector(_slo(objective=0.9))
+    for i in range(10):
+        det.observe_height(float(i), round_=2)
+    assert det.verdict(10.0) == CRITICAL
+
+
+def test_stalled_round_direct_critical_without_events():
+    det = StalledRoundDetector(_slo(objective=0.9), ceiling_s=20.0)
+    det.arm(0.0)
+    # a burn window over zero events never fires — the stall must page
+    # through the direct condition
+    assert det.verdict(10.0) == OK
+    assert det.verdict(25.0) == CRITICAL
+    assert det.last_value == 25.0
+    # a commit resets the stall clock
+    det.observe_height(26.0)
+    assert det.verdict(30.0) == OK
+    # near-stall intervals feed the SLO: repeated slow heights warn
+    t = 26.0
+    for _ in range(8):
+        t += 21.0
+        det.observe_height(t)
+    assert det.slo.verdict(t) == CRITICAL  # every interval over ceiling
+
+
+def test_stalled_round_near_stall_warns_before_paging():
+    # intervals past near_stall_fraction x ceiling but UNDER the
+    # ceiling book bad SLO events: the committee slipping toward the
+    # stall warns while the direct page stays quiet
+    det = StalledRoundDetector(_slo(objective=0.9), ceiling_s=20.0)
+    det.arm(0.0)
+    t = 0.0
+    for _ in range(8):
+        t += 12.0  # > 10 (near-stall bar), < 20 (page bar)
+        det.observe_height(t)
+        assert det._direct(t) == OK  # never pages
+    assert det.slo.verdict(t) >= WARN
+    assert det.verdict(t) >= WARN
+    # healthy cadence books good events and recovers as windows drain
+    det2 = StalledRoundDetector(_slo(objective=0.9), ceiling_s=20.0)
+    det2.arm(0.0)
+    t = 0.0
+    for _ in range(8):
+        t += 5.0
+        det2.observe_height(t)
+    assert det2.verdict(t) == OK
+
+
+def test_quorum_lag_warmup_learns_before_judging():
+    det = QuorumLagDetector(
+        _slo(objective=0.9, min_events=8), floor_s=0.025, min_baseline=16
+    )
+    # the first min_baseline samples are learning-only: even lags far
+    # over the floor record NO SLO events (you can't call an anomaly
+    # before a baseline exists — the clean gossip plane's genuine
+    # trickle spread would false-flag against the static floor)
+    for i in range(16):
+        det.observe_lag(float(i), 0.06)
+    assert len(det.slo._events) == 0
+    assert det.verdict(16.0) == OK
+    # post-warmup the learned tail IS the bar: 2 x p95(60 ms) = 120 ms
+    assert det.threshold() == pytest.approx(0.12)
+    det.observe_lag(17.0, 0.06)  # inside the learned spread: good
+    assert det.slo._events[-1][1] == 0
+
+
+def test_quorum_lag_baseline_not_poisoned_by_straggler():
+    det = QuorumLagDetector(
+        _slo(objective=0.9, min_events=8),
+        floor_s=0.025,
+        margin=4.0,
+        min_baseline=16,
+    )
+    # clean phase: sub-ms arrivals learn the baseline (16 warmup + 4
+    # judged-good)
+    for i in range(20):
+        det.observe_lag(float(i), 0.001)
+    assert det.verdict(20.0) == OK
+    thr_before = det.threshold()
+    assert thr_before == pytest.approx(0.025)  # floor dominates
+    # straggler phase: one of three arrivals comes 50 ms late
+    t = 20.0
+    for i in range(12):
+        t += 1.0
+        det.observe_lag(t, 0.05)
+        det.observe_lag(t, 0.001)
+        det.observe_lag(t, 0.001)
+    assert det.verdict(t) == WARN
+    # the bad samples were never admitted to the baseline: a persistent
+    # straggler keeps flagging instead of teaching the detector that
+    # 50 ms is normal
+    assert det.threshold() == pytest.approx(thr_before)
+    assert det.snapshot(t)["baseline_p95"] < 0.01
+    assert det.last_threshold == pytest.approx(0.025)
+    assert det.snapshot(t)["last_bad"] == pytest.approx(0.05)
+
+
+def test_scheduler_saturation_detector():
+    det = SchedulerSaturationDetector(
+        _slo(objective=0.8), depth_floor=256
+    )
+    # shallow queue: never saturated regardless of fill
+    for i in range(10):
+        det.observe_sample(float(i), 10.0, 1.0, 0)
+    assert det.verdict(10.0) == OK
+    # deep queue with no dispatch progress -> saturated -> warn
+    t = 10.0
+    for i in range(10):
+        t += 1.0
+        det.observe_sample(t, 500.0, 1.0, 0)
+    assert det.verdict(t) >= WARN
+    # deep queue but dispatches advancing with partial fill = the
+    # device is draining a burst, not saturated
+    det2 = SchedulerSaturationDetector(
+        _slo(objective=0.8), depth_floor=256
+    )
+    for i in range(10):
+        det2.observe_sample(float(i), 500.0, 0.5, 3)
+    assert det2.verdict(10.0) == OK
+
+
+def test_latency_drift_detector_learns_then_flags():
+    det = LatencyDriftDetector(
+        _slo(objective=0.8), drift_factor=4.0, abs_floor_s=0.001
+    )
+    # below min_baseline the threshold is inf: nothing can flag
+    for i in range(8):
+        det.observe_mean(float(i), 0.002)
+    assert det.verdict(8.0) == OK
+    thr = det.threshold()
+    assert thr == pytest.approx(0.008)  # 4 x the 2 ms median
+    # a degrading disk: interval means drift to 20 ms
+    t = 8.0
+    for i in range(10):
+        t += 1.0
+        det.observe_mean(t, 0.02)
+    assert det.verdict(t) >= WARN
+    # drifted samples never join the baseline
+    assert det.threshold() == pytest.approx(thr)
+
+
+def test_peer_flap_detector():
+    det = PeerFlapDetector(_slo(objective=0.8))
+    for i, n in enumerate((4, 4, 4, 4, 4, 4)):
+        det.observe_count(float(i), n)
+    assert det.verdict(6.0) == OK
+    # connect/drop cycling: every drop is a bad event
+    t = 6.0
+    for n in (3, 4, 2, 4, 1, 4, 2, 3):
+        t += 1.0
+        det.observe_count(t, n)
+    assert det.verdict(t) >= WARN
+    # a STABLE small peer set is fine — flap is churn, not size
+    det2 = PeerFlapDetector(_slo(objective=0.8))
+    for i in range(10):
+        det2.observe_count(float(i), 1)
+    assert det2.verdict(10.0) == OK
+
+
+def test_event_loop_lag_detector():
+    det = EventLoopLagDetector(_slo(objective=0.9, min_events=8),
+                               lag_warn_s=0.05)
+    for i in range(20):
+        det.observe_lag(float(i), 0.002)
+    assert det.verdict(20.0) == OK
+    # the loop-bound regime: sustained lag dominates BOTH windows (the
+    # long window needs >= 60% bad against the 10% budget to cross the
+    # 6x critical gate)
+    t = 20.0
+    for i in range(60):
+        t += 1.0
+        det.observe_lag(t, 0.2)
+    assert det.verdict(t) == CRITICAL
+
+
+# --- monitor: pull seams over real metric objects ---------------------------
+
+
+def _monitor(**kw):
+    kw.setdefault("tracer", obs.Tracer(enabled=True))
+    return HealthMonitor(**kw)
+
+
+def test_monitor_scheduler_seam():
+    reg = Registry()
+    sm = SchedulerMetrics(reg)
+    mon = _monitor()
+    mon.bind_scheduler(sm)
+    sm.queue_depth.inc(500, klass="consensus")
+    sm.batch_fill_ratio.set(1.0)
+    t = 0.0
+    for i in range(10):
+        t += 1.0
+        mon.sample(t)  # depth 500, fill 1.0, no dispatch progress
+    assert mon.detectors["scheduler_saturation"].verdict(t) >= WARN
+    assert mon.subsystem_verdicts(t)["scheduler"] >= WARN
+
+
+def test_monitor_wal_drift_seam():
+    reg = Registry()
+    hist = reg.histogram(
+        "wal_fsync_seconds", "", buckets=(0.001, 0.01, 0.1, float("inf"))
+    )
+    mon = _monitor()
+    mon.bind_wal(hist)
+    t = 0.0
+    mon.sample(t)  # establishes the cumulative baseline
+    # healthy disk: 2 ms fsyncs, interval means learn the baseline
+    for i in range(10):
+        for _ in range(4):
+            hist.observe(0.002)
+        t += 1.0
+        mon.sample(t)
+    assert mon.detectors["wal_fsync_drift"].verdict(t) == OK
+    # the disk degrades: 30 ms interval means, > 4 x the 2 ms median
+    for i in range(10):
+        for _ in range(4):
+            hist.observe(0.03)
+        t += 1.0
+        mon.sample(t)
+    assert mon.detectors["wal_fsync_drift"].verdict(t) >= WARN
+    assert mon.subsystem_verdicts(t)["wal"] >= WARN
+
+
+def test_monitor_sequencer_slo_seam():
+    reg = Registry()
+    hist = reg.histogram(
+        "sequencer_apply_latency_seconds",
+        "",
+        buckets=(0.01, 0.05, 0.1, 0.5, 1.0, float("inf")),
+    )
+    mon = _monitor()
+    mon.bind_sequencer(hist)
+    t = 0.0
+    mon.sample(t)
+    # 20 applies inside the 100 ms target: good
+    for _ in range(20):
+        hist.observe(0.02)
+    t += 1.0
+    mon.sample(t)
+    assert mon.detectors["sequencer_apply_slo"].verdict(t) == OK
+    # the polling-floor regression: applies land at 500 ms
+    for i in range(3):
+        for _ in range(20):
+            hist.observe(0.5)
+        t += 1.0
+        mon.sample(t)
+    assert mon.detectors["sequencer_apply_slo"].verdict(t) == CRITICAL
+    assert mon.subsystem_verdicts(t)["sequencer"] == CRITICAL
+
+
+def test_monitor_lightserve_hit_rate_seam():
+    reg = Registry()
+
+    class LS:
+        cache_hits = reg.counter("ls_hits", "")
+        cache_misses = reg.counter("ls_misses", "")
+
+    mon = _monitor()
+    mon.bind_lightserve(LS())
+    t = 0.0
+    LS.cache_hits.inc(100)
+    mon.sample(t)
+    t += 1.0
+    mon.sample(t)  # no new traffic: no event recorded
+    assert mon.detectors["lightserve_hit_rate"].verdict(t) == OK
+    # hit rate collapses to 50% against the 0.9 floor
+    for i in range(3):
+        LS.cache_hits.inc(50)
+        LS.cache_misses.inc(50)
+        t += 1.0
+        mon.sample(t)
+    assert mon.detectors["lightserve_hit_rate"].verdict(t) >= WARN
+
+
+def test_monitor_peer_seam_and_status_rollup():
+    class Sw:
+        peers = {}
+
+    mon = _monitor()
+    mon.bind_switch(Sw())
+    t = 0.0
+    sizes = [4, 4, 3, 4, 2, 4, 1, 4, 2, 4, 1, 4]
+    for n in sizes:
+        Sw.peers = {i: None for i in range(n)}
+        t += 1.0
+        mon.sample(t)
+    assert mon.detectors["peer_flap"].verdict(t) >= WARN
+    verdicts = mon.subsystem_verdicts(t)
+    assert verdicts["p2p"] >= WARN
+    assert mon.status(t) >= WARN
+    # untouched subsystems stay ok in the roll-up
+    assert verdicts["consensus"] == OK
+    assert verdicts["runtime"] == OK
+
+
+def test_monitor_seam_isolation_and_detector_thresholds():
+    """A pull seam that raises every tick (a bound metrics object
+    changing shape) must not starve the seams bound after it or the
+    end-of-tick evaluation — the watchdog-fails-dark class — and the
+    floor/flap detectors must carry the bar they judged against, not
+    the 0.0 Detector default."""
+
+    class BrokenDepth:
+        def total(self):
+            raise AttributeError("metrics object changed shape")
+
+    class BrokenSched:
+        queue_depth = BrokenDepth()
+
+    class Sw:
+        peers = {}
+
+    mon = _monitor()
+    mon.bind_scheduler(BrokenSched())  # first seam in the pull order
+    mon.bind_switch(Sw())  # last seam in the pull order
+    t = 0.0
+    sizes = [4, 4, 3, 4, 2, 4, 1, 4, 2, 4, 1, 4]
+    for n in sizes:
+        Sw.peers = {i: None for i in range(n)}
+        t += 1.0
+        mon.sample(t)  # scheduler raises every tick; p2p still feeds
+    assert mon.detectors["peer_flap"].verdict(t) >= WARN
+    # evaluation still ran: the flap transition emitted its incident
+    assert any(i["detector"] == "peer_flap" for i in mon.incidents)
+    # the flap threshold is the count the drop came FROM, surviving
+    # the recovery ticks in between
+    assert mon.detectors["peer_flap"].last_threshold == 4.0
+    # the hit-rate floor detector's threshold IS its SLO objective
+    ls = mon.detectors["lightserve_hit_rate"]
+    assert ls.last_threshold == ls.slo.objective > 0.0
+
+
+def test_status_query_pages_unstarted_stall():
+    """A node stalled from genesis: start() never called, no feeds at
+    all. The first status query arms the stall clock; a query past the
+    ceiling must page CRITICAL — and status()/verdict() must agree
+    (the soak divergence artifact carries both)."""
+    mon = _monitor(stall_ceiling_s=10.0)
+    assert mon.status(0.0) == OK  # arms at first evaluation
+    assert mon.status(5.0) == OK
+    assert mon.status(11.0) == CRITICAL
+    assert mon.subsystem_verdicts(11.0)["consensus"] == CRITICAL
+    doc = mon.verdict(11.0)
+    assert doc["status"] == "critical"
+    assert any(i["detector"] == "stalled_round" for i in mon.incidents)
+    # a commit recovers it on the next query
+    mon.observe_height_committed(7, 0, t=12.0)
+    assert mon.status(12.5) == OK
+
+
+# --- monitor: incidents, gauges, verdict document ---------------------------
+
+
+def _drive_quorum_warn(mon, t0=0.0):
+    """Deterministic OK->WARN flip of the quorum-lag detector: 40
+    clean sub-ms arrivals (32 warmup + 8 judged good), then a quarter
+    of the stream straggling at 50 ms against the 25 ms floor — ~4x
+    the 5% budget: warn, under the 6x critical gate."""
+    t = t0
+    for i in range(40):
+        t += 0.1
+        mon.observe_vote_arrival(1, 0.001, t=t)
+    for i in range(12):
+        t += 0.1
+        mon.observe_vote_arrival(1, 0.05, t=t)
+        for _ in range(3):
+            mon.observe_vote_arrival(1, 0.001, t=t)
+    mon.observe_height_committed(5, 0, t=t)  # commits trigger _evaluate
+    return t
+
+
+def test_incident_emission_into_tracer_and_gauges():
+    tracer = obs.Tracer(enabled=True)
+    reg = Registry()
+    hm = HealthMetrics(reg)
+    mon = HealthMonitor(tracer=tracer, metrics=hm)
+    t = _drive_quorum_warn(mon)
+
+    assert mon.detectors["quorum_lag"].verdict(t) == WARN
+    # the transition emitted exactly one structured incident
+    incidents = [r for r in tracer.records() if r.name == "health.incident"]
+    assert len(incidents) == 1
+    f = incidents[0].fields
+    assert f["slo"] == "quorum_lag"
+    assert f["subsystem"] == "consensus"
+    assert (f["from"], f["to"]) == ("ok", "warn")
+    # the escalation carries the OFFENDING lag (the 50 ms straggler),
+    # not whatever good sample arrived after it
+    assert f["value"] == pytest.approx(0.05)
+    assert f["value"] > f["threshold"] > 0
+    assert mon.incidents[-1]["detector"] == "quorum_lag"
+
+    # gauges carry the roll-up: tm_health_status{subsystem="consensus"}
+    # >= warn, burn rate exported per slo, incident counted
+    assert hm.status.value(subsystem="consensus") >= WARN
+    assert hm.burn_rate.value(slo="quorum_lag") >= 1.0
+    assert hm.incidents.value(subsystem="consensus") == 1
+    body = reg.render()
+    assert 'tm_health_status{subsystem="consensus"}' in body
+    assert 'tm_slo_burn_rate{slo="quorum_lag"}' in body
+
+    # recovery: the stream goes quiet, both windows drain, the detector
+    # un-pages and the ok transition is ALSO an incident record
+    mon.observe_height_committed(6, 0, t=t + 400.0)
+    incidents = [r for r in tracer.records() if r.name == "health.incident"]
+    assert incidents[-1].fields["to"] == "ok"
+    assert hm.status.value(subsystem="consensus") == OK
+
+
+def test_verdict_document_shape():
+    mon = HealthMonitor(tracer=obs.Tracer(enabled=True))
+    t = _drive_quorum_warn(mon)
+    doc = mon.verdict(t)
+    assert doc["status"] == "warn" and doc["code"] == WARN
+    assert set(doc["subsystems"]) == {
+        "consensus", "scheduler", "wal", "sequencer", "lightserve",
+        "p2p", "runtime",
+    }
+    cons = doc["subsystems"]["consensus"]
+    assert cons["status"] == "warn"
+    assert cons["detectors"]["quorum_lag"]["status"] == "warn"
+    assert cons["detectors"]["quorum_lag"]["burn_long"] >= 1.0
+    assert cons["detectors"]["round_churn"]["status"] == "ok"
+    assert doc["incidents"][-1]["to"] == "warn"
+    # stall pages through verdict() even with no event feed at all
+    mon2 = HealthMonitor(tracer=obs.Tracer(enabled=True),
+                         stall_ceiling_s=20.0)
+    mon2.stalled_round.arm(0.0)
+    doc2 = mon2.verdict(25.0)
+    assert doc2["subsystems"]["consensus"]["status"] == "critical"
+
+
+def test_monitor_determinism_on_identical_streams():
+    def drive(mon):
+        t = 0.0
+        for i in range(30):
+            t += 0.5
+            mon.observe_vote_arrival(1, 0.05 if i % 3 == 0 else 0.001, t=t)
+            if i % 5 == 4:
+                mon.observe_height_committed(i // 5 + 1, i % 2, t=t)
+        return mon.verdict(t)
+
+    a = drive(HealthMonitor(tracer=obs.Tracer(enabled=True)))
+    b = drive(HealthMonitor(tracer=obs.Tracer(enabled=True)))
+    assert a == b
+
+
+def test_monitor_from_config_and_validation():
+    from tendermint_tpu.config.config import HealthConfig
+
+    hc = HealthConfig()
+    hc.validate_basic()
+    mon = HealthMonitor.from_config(hc, stall_ceiling_s=12.5)
+    assert mon.stalled_round.ceiling_s == 12.5
+    assert mon.quorum_lag.floor_s == hc.quorum_lag_floor
+    assert mon.interval == hc.interval
+    for field, bad in (
+        ("interval", 0.0),
+        ("short_window", 400.0),  # > long_window
+        ("cache_hit_floor", 1.5),
+        ("stall_factor", -1.0),
+        ("scheduler_depth_floor", 0),
+    ):
+        broken = HealthConfig(**{field: bad})
+        with pytest.raises(ValueError):
+            broken.validate_basic()
+
+
+def test_heartbeat_probe_measures_loop_lag():
+    """The event-loop lag probe: a blocking callback makes the
+    heartbeat's sleep overshoot, and the overshoot lands in the
+    detector's SLO stream (the PR 9 loop-bound regime, measured)."""
+
+    async def run():
+        mon = HealthMonitor(
+            tracer=obs.Tracer(enabled=True),
+            interval=10.0,  # keep the sample loop out of the way
+            heartbeat_interval=0.02,
+        )
+        await mon.start()
+        try:
+            await asyncio.sleep(0.1)  # a few clean beats
+            clean = len(mon.event_loop_lag.slo._events)
+            assert clean >= 2
+            time.sleep(0.25)  # block the loop: the next beat is late
+            await asyncio.sleep(0.05)
+            # the overshoot was recorded as a bad event (clean beats
+            # may have followed and moved last_value on)
+            assert mon.event_loop_lag.last_bad >= 0.1
+            assert any(
+                b for _, b, _ in mon.event_loop_lag.slo._events
+            )
+        finally:
+            await mon.stop()
+        assert not mon._tasks
+
+    asyncio.run(run())
+
+
+# --- chaos e2e: the straggler flips exactly the quorum-lag detector ---------
+
+
+@pytest.mark.chaos
+def test_chaos_straggler_flips_quorum_lag_to_warn():
+    """PR 5 weighted-quorum topology (powers 40/20/20/20: the heavy
+    validator's vote is required by every 2/3) with a live health plane
+    on every node. Phase 1 runs clean — zero false-critical, quorum-lag
+    ok everywhere while the baselines learn the committee's genuine
+    clean arrival spread (gossip-tick vote trickle: ~100 ms p95 on this
+    in-proc harness — measured, which is WHY the detector learns its
+    bar instead of trusting a static floor, and why the injection must
+    sit above that spread and shape every one of the straggler's
+    outbound links: a single shaped link is masked by mesh relay).
+    Phase 2 makes the heavy validator a straggler (400 ms added to all
+    its outbound links): within K=10 heights the victim's quorum-lag
+    detector must flip to warn — the lag is phase-absorbed on vote
+    types where the whole committee waited on heavy (everyone's
+    precommit shifts together when its prevote was the late one), so
+    the straggler shows on ~10% of the victim's pre-quorum arrivals:
+    ~2x the 5% budget, over the warn gate and far under the critical
+    one (measured: 10 bad of ~97 judged, stable across seeds). The
+    transition must land a
+    `health.incident` record in the victim's dump_traces ring, and
+    tm_health_status{subsystem="consensus"} must read >= warn — while
+    nothing ever reaches critical and every non-consensus subsystem
+    stays ok (the straggler is a consensus-plane fault)."""
+    from tendermint_tpu.chaos.link import LinkPolicy
+    from tendermint_tpu.chaos.network import ChaosNetwork
+
+    from .chaos_harness import (
+        build_chaos_handles,
+        node_dump,
+        start_mesh,
+        stop_mesh,
+    )
+
+    monitors: dict[str, HealthMonitor] = {}
+    registries: dict[str, Registry] = {}
+
+    def health_factory(name, tracer):
+        reg = Registry()
+        monitors[name] = HealthMonitor(
+            tracer=tracer, metrics=HealthMetrics(reg)
+        )
+        registries[name] = reg
+        return monitors[name]
+
+    handles = build_chaos_handles(
+        tracer_factory=lambda name: obs.Tracer(enabled=True),
+        ping_interval=0.5,
+        powers=(40, 20, 20, 20),
+        health_factory=health_factory,
+    )
+    vals = handles[0].cs.state.validators.validators
+    heavy_idx = max(range(len(vals)), key=lambda i: vals[i].voting_power)
+    victim_idx = (heavy_idx + 1) % len(handles)
+    heavy, victim = f"n{heavy_idx}", f"n{victim_idx}"
+    K = 10
+
+    async def run():
+        net = ChaosNetwork(seed=7)
+        for h in handles:
+            net.install(h)
+        await start_mesh(handles)
+        try:
+            # phase 1: clean heights — baselines learn, nothing flags.
+            # 8 heights put every node's arrival count comfortably past
+            # the 32-sample learning-only warmup (~6-8 pre-quorum
+            # arrivals per height per node): if warmup straddled the
+            # fault injection, the straggler's lags would be ADMITTED
+            # to the baseline and teach the detector the fault
+            await asyncio.gather(
+                *(h.cs.wait_for_height(8, timeout=120) for h in handles)
+            )
+            for name, m in monitors.items():
+                assert (
+                    len(m.quorum_lag._baseline)
+                    >= m.quorum_lag.min_baseline
+                ), f"{name}: quorum-lag baseline warmup incomplete"
+            clean = {
+                name: m.verdict() for name, m in monitors.items()
+            }
+            # phase 2: the heavy validator straggles on EVERY outbound
+            # link — its votes/proposals leave late no matter which
+            # relay path carries them to the committee. 400 ms clears
+            # the worst learned bar a host-stuttered clean phase can
+            # set (2 x p95 ~ 0.3 s observed under CI contention); the
+            # round churn it may force on heavy-proposed heights is
+            # itself a consensus-plane warn the assertions tolerate
+            for h in handles:
+                if h.name != heavy:
+                    net.set_link_policy(
+                        heavy,
+                        h.name,
+                        LinkPolicy(latency_s=0.4),
+                        reverse=LinkPolicy(),
+                    )
+            h_clear = max(h.cs.state.last_block_height for h in handles)
+            await asyncio.gather(
+                *(
+                    h.cs.wait_for_height(h_clear + K, timeout=180)
+                    for h in handles
+                )
+            )
+            dump = node_dump(handles[victim_idx])
+            hashes = {
+                h.block_store.load_block(h_clear + K).hash()
+                for h in handles
+            }
+            post = {name: m.verdict() for name, m in monitors.items()}
+            return clean, post, dump, hashes
+        finally:
+            await stop_mesh(handles)
+
+    clean, post, dump, hashes = asyncio.run(run())
+
+    # liveness + agreement through the degraded regime
+    assert len(hashes) == 1, "nodes disagree under the straggler link"
+
+    # clean phase: zero false-critical anywhere (the acceptance bar —
+    # NOT "zero warn": a genuinely stuttering host produces genuine
+    # 250 ms+ arrival spreads with no fault injected, and a warn there
+    # is a true positive, observed roughly once per ten CI runs)
+    for name, doc in clean.items():
+        assert doc["status"] != "critical", (name, doc)
+        for sub, entry in doc["subsystems"].items():
+            for det, state in entry["detectors"].items():
+                assert state["status"] != "critical", (name, det, state)
+
+    # chaos phase: the victim's quorum-lag detector is at warn — and
+    # warn only (~10% of pre-quorum arrivals flag, ~2x the 5% budget,
+    # under the 6x critical gate)
+    vdoc = post[victim]
+    vdet = vdoc["subsystems"]["consensus"]["detectors"]["quorum_lag"]
+    assert vdet["status"] == "warn", vdoc
+    assert vdet["last_bad"] > 0.3, vdet  # the observed straggler lag
+    # the learned bar sits between the floor and the injection: the
+    # baseline covered the clean trickle without swallowing the fault
+    assert 0.025 <= vdet["threshold"] < 0.4, vdet
+
+    # nothing reached critical on any node, and every warned detector
+    # is consensus-plane (quorum_lag, or round_churn when the straggler
+    # forced a retry round) — no cross-subsystem false positives
+    for name, doc in post.items():
+        assert doc["status"] != "critical", (name, doc)
+        for sub, entry in doc["subsystems"].items():
+            for det, state in entry["detectors"].items():
+                if state["status"] != "ok":
+                    assert det in ("quorum_lag", "round_churn"), (
+                        name, det, state,
+                    )
+                    assert sub == "consensus"
+
+    # the incident landed in the victim's dump_traces ring: flight
+    # dumps now carry WHY (detector, threshold, observed value)
+    incidents = [
+        r for r in dump["records"] if r["name"] == "health.incident"
+    ]
+    assert any(
+        r["fields"]["slo"] == "quorum_lag" and r["fields"]["to"] == "warn"
+        for r in incidents
+    ), incidents
+
+    # and the gauge surface agrees: tm_health_status >= warn for the
+    # consensus subsystem, ok for every other
+    status = registries[victim].render()
+    g = monitors[victim].metrics.status
+    assert g.value(subsystem="consensus") >= WARN
+    assert 'tm_health_status{subsystem="consensus"}' in status
+    for sub in ("scheduler", "wal", "sequencer", "lightserve", "p2p",
+                "runtime"):
+        assert g.value(subsystem=sub) == OK, sub
+
+
+# --- bench-trend: backend-partitioned regression gate -----------------------
+
+
+def _bt():
+    sys.path.insert(0, REPO)
+    from tools import bench_trend
+
+    return bench_trend
+
+
+def test_trend_family_and_direction_classification():
+    bt = _bt()
+    assert bt.family_of("ed25519_vote_verify_throughput") == "crypto"
+    assert bt.family_of("consensus_pacing_wall_per_height") == (
+        "consensus_pacing"
+    )
+    assert bt.family_of("sequencer_stream_blocks_per_s") == (
+        "sequencer_stream"
+    )
+    assert bt.family_of("lightserve_clients_per_s") == "lightserve"
+    assert bt.direction_of("ed25519_vote_verify_throughput") == "higher"
+    assert bt.direction_of("consensus_pacing_wall_per_height") == "lower"
+    assert bt.direction_of("sequencer_apply_latency_p95") == "lower"
+    assert bt.direction_of("bls_aggregate_verify_1k") == "lower"  # override
+
+
+def test_trend_backend_partition_and_gate_math(tmp_path):
+    bt = _bt()
+
+    def art(name, metric, value, backend, rnd, extra=None):
+        p = tmp_path / f"BENCH_{name}_r{rnd:02d}.json"
+        doc = {
+            "metric": metric,
+            "value": value,
+            "unit": "sigs/s",
+            "meta": {"backend": backend, "device_count": 1},
+        }
+        if extra:
+            doc["extra_metrics"] = extra
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    files = [
+        art("tpu_a", "ed25519_vote_verify_throughput", 77000.0, "tpu", 2),
+        art("tpu_b", "ed25519_vote_verify_throughput", 75000.0, "tpu", 3),
+        art("cpu_a", "ed25519_vote_verify_throughput", 2300.0, "cpu", 4),
+        art("cpu_b", "ed25519_vote_verify_throughput", 2250.0, "cpu", 6),
+    ]
+    rows, skipped = bt.ingest(files)
+    assert not skipped and len(rows) == 4
+    groups = bt.build_groups(rows)
+    # rows partition by backend: the 2.3k CPU rows NEVER compare
+    # against the 77k TPU captures
+    assert len(groups) == 2
+    by_backend = {g["backend"]: g for g in groups}
+    assert by_backend["cpu"]["best"] == 2300.0
+    assert by_backend["cpu"]["regression"] == pytest.approx(
+        (2300.0 - 2250.0) / 2300.0, abs=1e-4
+    )
+    assert by_backend["tpu"]["regression"] == pytest.approx(
+        (77000.0 - 75000.0) / 77000.0, abs=1e-4
+    )
+    failures, warnings = bt.check_gate(groups, threshold=0.15)
+    assert not failures and not warnings
+
+    # a 20% same-backend regression of a tier-1 headline fails the gate
+    files.append(
+        art("cpu_c", "ed25519_vote_verify_throughput", 1840.0, "cpu", 7)
+    )
+    rows, _ = bt.ingest(files)
+    failures, _ = bt.check_gate(bt.build_groups(rows), threshold=0.15)
+    assert len(failures) == 1
+    assert failures[0]["backend"] == "cpu"
+    assert failures[0]["regression"] > 0.15
+
+    # extra-metric regressions warn instead of failing (strict flips)
+    files = files[:4] + [
+        art(
+            "cpu_x",
+            "ed25519_vote_verify_throughput",
+            2290.0,
+            "cpu",
+            8,
+            extra=[
+                {"metric": "ed25519_commit10k_latency", "value": 100.0,
+                 "unit": "ms"},
+            ],
+        ),
+        art(
+            "cpu_y",
+            "ed25519_vote_verify_throughput",
+            2280.0,
+            "cpu",
+            9,
+            extra=[
+                {"metric": "ed25519_commit10k_latency", "value": 150.0,
+                 "unit": "ms"},
+            ],
+        ),
+    ]
+    rows, _ = bt.ingest(files)
+    failures, warnings = bt.check_gate(bt.build_groups(rows), 0.15)
+    assert not failures and len(warnings) == 1
+    failures, warnings = bt.check_gate(
+        bt.build_groups(rows), 0.15, strict=True
+    )
+    assert len(failures) == 1 and not warnings
+
+
+def test_trend_ingest_normalizes_historical_shapes(tmp_path):
+    bt = _bt()
+    # r01–r04 wrapped shape with a capture tail naming the platform
+    wrapped = tmp_path / "BENCH_r90.json"
+    wrapped.write_text(json.dumps({
+        "rc": 0,
+        "tail": "WARNING ... Platform 'axon' is experimental",
+        "parsed": {"metric": "ed25519_vote_verify_throughput",
+                   "value": 70000.0, "unit": "sigs/s/chip"},
+    }))
+    # structured backend-mismatch failure: a skip, never a value
+    failed = tmp_path / "BENCH_r91.json"
+    failed.write_text(json.dumps({
+        "rc": 1, "error": "no TPU endpoint", "kind": "backend_mismatch",
+        "backend": "cpu",
+    }))
+    # unreadable artifact: a skip, not a crash
+    broken = tmp_path / "BENCH_r92.json"
+    broken.write_text("{not json")
+    rows, skipped = bt.ingest([str(wrapped), str(failed), str(broken)])
+    assert len(rows) == 1
+    assert rows[0]["backend"] == "tpu"  # inferred from the tail
+    assert rows[0]["round"] == 90
+    assert {s["file"] for s in skipped} == {
+        "BENCH_r91.json", "BENCH_r92.json",
+    }
+
+
+def test_trend_cli_check_over_checked_in_artifacts(tmp_path):
+    """The acceptance gate: --check over BENCH_r01–r11 + MULTICHIP_r*
+    exits 0 — the honest-CPU rows (ed25519 vote verify ~2.1k sigs/s)
+    must NOT flag against the r02/r03 TPU captures (77k) because the
+    backend partition keeps them in separate groups — and exits
+    non-zero when fed a synthetic 20%-regressed row on a MATCHING
+    backend."""
+    bt = _bt()
+    out = subprocess.run(
+        [sys.executable, "tools/bench_trend.py", "--check", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["check"]["ok"] is True
+    # both backend groups of the same metric coexist, 33x apart
+    groups = {
+        (g["metric"], g["backend"]): g for g in doc["groups"]
+    }
+    tpu = groups[("ed25519_vote_verify_throughput", "tpu")]
+    cpu = groups[("ed25519_vote_verify_throughput", "cpu")]
+    assert tpu["best"] > 10 * cpu["best"]
+    assert tpu["regression"] <= 0.15 and cpu["regression"] <= 0.15
+
+    # synthetic regression: consensus_pacing wall/height 25% WORSE on
+    # the same (cpu, 1-device) group as the checked-in r08 capture
+    reg_row = tmp_path / "BENCH_r99.json"
+    reg_row.write_text(json.dumps({
+        "metric": "consensus_pacing_wall_per_height",
+        "value": 567.4,  # r08 recorded 453.9 ms/height
+        "unit": "ms/height",
+        "meta": {"backend": "cpu", "device_count": 1},
+    }))
+    out = subprocess.run(
+        [sys.executable, "tools/bench_trend.py", "--check", str(reg_row)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert out.returncode == 1
+    assert "consensus_pacing_wall_per_height" in out.stderr
+    assert "FAIL tier-1 regression" in out.stderr
+
+    # the SAME row on a different backend cannot flag: partition holds
+    mismatched = tmp_path / "BENCH_r98.json"
+    mismatched.write_text(json.dumps({
+        "metric": "consensus_pacing_wall_per_height",
+        "value": 567.4,
+        "unit": "ms/height",
+        "meta": {"backend": "tpu", "device_count": 1},
+    }))
+    out = subprocess.run(
+        [sys.executable, "tools/bench_trend.py", "--check",
+         str(mismatched)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+
+
+def test_trend_write_renders_tables(tmp_path):
+    """--write produces TREND.md + TREND.json; the table marks the
+    tier-1 families and the skip section lists failure artifacts."""
+    out = subprocess.run(
+        [sys.executable, "tools/bench_trend.py", "--write", "--dir",
+         str(tmp_path), "--no-scan",
+         os.path.join(REPO, "BENCH_r08.json"),
+         os.path.join(REPO, "BENCH_r07.json")],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    md = (tmp_path / "TREND.md").read_text()
+    assert "consensus_pacing (tier-1)" in md
+    assert "BENCH_r07.json" in md  # the structured failure is a skip
+    doc = json.loads((tmp_path / "TREND.json").read_text())
+    assert doc["schema"] == "tm-tpu/bench-trend/v1"
+    assert doc["skipped"] and doc["groups"]

@@ -3,6 +3,7 @@ validity (HELP/TYPE ordering, cumulative `le` monotonicity, label-value
 escaping), the Registry kind-collision guard, labeled histograms, and
 the /metrics server's path/verb handling."""
 
+import os
 import asyncio
 import re
 
@@ -11,8 +12,10 @@ import pytest
 from tendermint_tpu.libs.metrics import (
     Counter,
     Gauge,
+    HealthMetrics,
     Histogram,
     MetricsServer,
+    ProcessMetrics,
     Registry,
 )
 
@@ -197,6 +200,88 @@ def test_metrics_server_paths_and_verbs():
     )
     assert clen == len(reg.render().encode())
     assert post.startswith(b"HTTP/1.1 405")
+
+
+def test_counter_total_across_series():
+    c = Counter("c", "", labels=("klass",))
+    c.inc(3, klass="a")
+    c.inc(4, klass="b")
+    assert c.total() == 7
+    assert Counter("e", "", labels=("k",)).total() == 0
+
+
+def test_histogram_series_snapshot():
+    # the health monitor reads interval DELTAS of these snapshots to
+    # turn a histogram into an SLO event stream
+    h = Histogram("h", "", buckets=(0.1, 1.0, float("inf")),
+                  labels=("step",))
+    h.observe(0.05, step="a")
+    h.observe(0.5, step="a")
+    h.observe(2.0, step="a")
+    s = h.series(step="a")
+    assert s["count"] == 3
+    assert s["sum"] == pytest.approx(2.55)
+    assert tuple(s["buckets"]) == (0.1, 1.0, float("inf"))
+    assert s["counts"] == [1, 2, 3]  # cumulative per bucket
+    empty = h.series(step="missing")
+    assert empty["count"] == 0 and empty["counts"] == [0, 0, 0]
+
+
+# --- process-level gauges + health gauges (raw-name families) ---------------
+
+
+def test_process_metrics_exposition_golden():
+    """RSS / open-fd / thread gauges render under their conventional
+    prometheus process_* names (NO tm_ namespace prefix — dashboards
+    key on the convention), refresh at scrape time via the registry
+    collector, and the event-loop-lag histogram rides the same raw
+    namespace."""
+    reg = Registry(namespace="tm")
+    pm = ProcessMetrics(reg)
+    body = reg.render()
+    for family, kind in (
+        ("process_resident_memory_bytes", "gauge"),
+        ("process_open_fds", "gauge"),
+        ("process_threads", "gauge"),
+        ("tm_event_loop_lag_seconds", "histogram"),
+    ):
+        assert f"# TYPE {family} {kind}" in body, family
+        assert f"tm_{family}" not in body  # raw: no namespace prefix
+    # the collector refreshed the point-in-time reads at render
+    assert pm.threads.value() >= 1
+    assert pm.rss_bytes.value() > 0
+    if os.path.isdir("/proc/self/fd"):
+        # fd counting is /proc-backed and best-effort elsewhere
+        assert pm.open_fds.value() > 0
+    pm.event_loop_lag.observe(0.03)
+    assert 'tm_event_loop_lag_seconds_bucket{le="0.05"} 1' in reg.render()
+
+
+def test_registry_collector_errors_are_dropped():
+    # /metrics must not 500 because a collector broke
+    reg = Registry(namespace="tm")
+    g = reg.gauge("x", "")
+    g.set(1)
+
+    def boom():
+        raise RuntimeError("collector broke")
+
+    reg.add_collector(boom)
+    assert "tm_x 1" in reg.render()
+
+
+def test_health_metrics_raw_names():
+    reg = Registry(namespace="tm")
+    hm = HealthMetrics(reg)
+    hm.status.set(1, subsystem="consensus")
+    hm.burn_rate.set(2.5, slo="quorum_lag")
+    hm.incidents.inc(subsystem="consensus")
+    body = reg.render()
+    assert 'tm_health_status{subsystem="consensus"} 1' in body
+    assert 'tm_slo_burn_rate{slo="quorum_lag"} 2.5' in body
+    assert 'tm_health_incidents_total{subsystem="consensus"} 1' in body
+    # raw names: the tm_ namespace is NOT prepended a second time
+    assert "tm_tm_health_status" not in body
 
 
 def test_gauge_dec_and_track_inprogress():

@@ -80,8 +80,14 @@ def test_node_runs_chain_and_serves_rpc(tmp_path):
         abci = await rpc.call("abci_info")
         assert abci["response"]["data"] == "kvstore"
 
+        # health carries identity + verdict now (PR 11), not the
+        # reference's `{}` stub
         h = await rpc.call("health")
-        assert h == {}
+        assert h["node_id"] == node.node_key.id
+        assert int(h["latest_block_height"]) >= 3
+        assert h["catching_up"] is False
+        assert h["monitored"] is True
+        assert h["status"] in ("ok", "warn", "critical")
 
         gen = await rpc.call("genesis")
         assert gen["genesis"]["chain_id"] == node.genesis.chain_id

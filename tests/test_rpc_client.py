@@ -55,8 +55,18 @@ def test_http_ws_local_clients(tmp_path):
         # --- websocket: rpc over ws + event subscription
         ws = WSClient(addr)
         await ws.connect()
+        # health is no longer the reference's `{}` stub: it carries the
+        # node identity, sync position, and the monitor verdict
         h = await ws.call("health")
-        assert h == {}
+        assert h["node_id"] == status["node_info"]["id"]
+        assert int(h["latest_block_height"]) >= 3
+        assert h["catching_up"] is False
+        assert h["monitored"] is True
+        assert h["status"] in ("ok", "warn", "critical")
+        dump = await ws.call("dump_health")
+        assert dump["enabled"] is True
+        assert "consensus" in dump["subsystems"]
+        assert "quorum_lag" in dump["subsystems"]["consensus"]["detectors"]
         events = await ws.subscribe("tm.event = 'NewBlock'")
         ev = await asyncio.wait_for(events.__anext__(), 30)
         assert ev["query"] == "tm.event = 'NewBlock'"

@@ -1,0 +1,539 @@
+"""Bench-trajectory trend + regression gate over BENCH_r*/MULTICHIP_r*.
+
+Every PR leaves a BENCH_rNN.json (and sometimes MULTICHIP_rNN.json)
+artifact, but nothing compared them across runs: the r04–r06 "silent
+perf regression" (a TPU-tunnel outage quietly turning 77k sigs/s rows
+into 2k sigs/s CPU-fallback rows) was only found by archaeology. This
+tool makes the trajectory first-class:
+
+- **Ingest** every artifact round, normalizing the three historical
+  shapes (wrapped `{parsed: ...}` rows from r01–r04, direct metric
+  dicts from r05+, structured backend-mismatch failures from r07+) into
+  flat rows; failure artifacts are recorded as skips, never as values.
+
+- **Backend partition**: rows group by (family, metric, backend,
+  device_count) and are ONLY ever compared within a group. Backend
+  comes from the PR 6 `meta` stamp when present; pre-meta artifacts
+  fall back to the top-level `backend` field, then to the capture tail
+  (the r01–r03 tails name the accelerator platform), then to "cpu" —
+  the honest default for this harness, where every unlabeled post-r04
+  row WAS a CPU row. An honest CPU row can therefore never flag
+  against the r02/r03 TPU captures, and a TPU recapture never
+  "improves on" CPU numbers.
+
+- **Gate** (`--check`): exit non-zero when any tier-1 family's
+  HEADLINE metric (the artifact's top-level row) regressed more than
+  `--threshold` (15% default) against the best-known value on the same
+  backend/device-count. Regressions in `extra_metrics` rows are
+  reported as warnings (they fail only under `--strict`) — the
+  checked-in history contains honest host-noise swings there
+  (e.g. ed25519_commit10k_latency r05→r06: +26% on an unrelated-PR
+  rerun), and a gate that cries wolf gets deleted.
+
+- **Render**: TREND.md (per-family tables: best/latest/delta with the
+  round each came from) + machine-readable TREND.json.
+
+Usage:
+    python tools/bench_trend.py                       # print TREND.md
+    python tools/bench_trend.py --write               # write TREND.{md,json}
+    python tools/bench_trend.py --check               # CI gate
+    python tools/bench_trend.py --check extra_r99.json  # + synthetic rows
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# --- metric classification --------------------------------------------------
+
+# ordered prefix -> family (first match wins; longer prefixes first)
+_FAMILY_PREFIXES = (
+    ("consensus_pacing", "consensus_pacing"),
+    ("consensus_", "consensus"),
+    ("lightserve", "lightserve"),
+    ("light_", "light"),
+    ("committee", "committee_scale"),
+    ("sequencer", "sequencer_stream"),
+    ("commit_", "commit_path"),
+    ("wal_", "commit_path"),
+    ("blocksync", "blocksync"),
+    ("quorum_", "consensus"),
+    ("vote_latency", "crypto"),
+    ("ed25519", "crypto"),
+    ("bls_", "crypto"),
+    ("sr25519", "crypto"),
+    ("secp256k1", "crypto"),
+    ("sha256", "crypto"),
+    ("multichip", "multichip"),
+)
+
+# families whose headline rows gate CI (--check); the rest are
+# informational trend lines
+TIER1_FAMILIES = frozenset(
+    {
+        "crypto",
+        "consensus_pacing",
+        "consensus",
+        "lightserve",
+        "light",
+        "committee_scale",
+        "sequencer_stream",
+        "commit_path",
+        "blocksync",
+        "multichip",
+    }
+)
+
+# metric-name tokens that mean lower-is-better; everything else
+# defaults to higher-is-better (throughputs, rates, reductions)
+_LOWER_TOKENS = (
+    "latency",
+    "_ms",
+    "wall",
+    "_lag",
+    "fsync",
+    "floor_share",
+    "wait",
+    "critical_path",
+    "_ticks",
+    "per_key",
+    "encodes_per",
+    "_behind",
+)
+
+# oddballs the token heuristic can't classify from the name alone
+_DIRECTION_OVERRIDES = {
+    "bls_aggregate_verify_1k": "lower",  # ms for a 1k-signer aggregate
+    "light_bisection_1k": "higher",  # sigs/s on the 1k-validator chain
+}
+
+
+def family_of(metric: str) -> str:
+    for prefix, fam in _FAMILY_PREFIXES:
+        if metric.startswith(prefix):
+            return fam
+    return metric.split("_", 1)[0] or "other"
+
+
+def direction_of(metric: str, unit: str = "") -> str:
+    """'higher' or 'lower' (is better)."""
+    ov = _DIRECTION_OVERRIDES.get(metric)
+    if ov:
+        return ov
+    if any(tok in metric for tok in _LOWER_TOKENS):
+        return "lower"
+    u = (unit or "").strip().lower()
+    if u.startswith("ms") or u == "s" or u.startswith("s for"):
+        return "lower"
+    return "higher"
+
+
+# --- artifact normalization -------------------------------------------------
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _round_of(path: str, fallback: int) -> int:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+def _infer_backend(doc: dict, payload: dict) -> str:
+    """meta stamp > explicit backend field > capture-tail platform name
+    > 'cpu' (the honest default: every unlabeled row this harness ever
+    produced was a CPU row; the real-silicon captures name their
+    platform in the tail)."""
+    for d in (payload, doc):
+        meta = d.get("meta")
+        if isinstance(meta, dict) and meta.get("backend"):
+            return str(meta["backend"])
+    for d in (payload, doc):
+        b = d.get("backend")
+        if isinstance(b, str) and b:
+            return b
+    tail = str(doc.get("tail", ""))
+    if "Platform 'axon'" in tail or "platform 'tpu'" in tail.lower():
+        return "tpu"
+    return "cpu"
+
+
+def _device_count(doc: dict, payload: dict) -> int:
+    for d in (payload, doc):
+        meta = d.get("meta")
+        if isinstance(meta, dict) and meta.get("device_count"):
+            return int(meta["device_count"])
+    if doc.get("n_devices"):
+        return int(doc["n_devices"])
+    return 1
+
+
+def _metric_rows(payload: dict) -> list[tuple[dict, bool]]:
+    """(row_dict, is_headline) pairs from one normalized payload."""
+    rows = []
+    if payload.get("metric") is not None and payload.get("value") is not None:
+        rows.append((payload, True))
+    for e in payload.get("extra_metrics") or []:
+        if (
+            isinstance(e, dict)
+            and e.get("metric") is not None
+            and e.get("value") is not None
+        ):
+            rows.append((e, False))
+    # multichip per-device-count series (PR 6 capture format)
+    for e in payload.get("series") or []:
+        if (
+            isinstance(e, dict)
+            and e.get("metric") is not None
+            and e.get("value") is not None
+        ):
+            rows.append((e, True))
+    return rows
+
+
+def ingest(paths: list[str]) -> tuple[list[dict], list[dict]]:
+    """Normalize artifacts into (rows, skipped)."""
+    rows: list[dict] = []
+    skipped: list[dict] = []
+    for i, path in enumerate(paths):
+        name = os.path.basename(path)
+        rnd = _round_of(path, fallback=1000 + i)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            skipped.append({"file": name, "reason": f"unreadable: {e}"})
+            continue
+        if not isinstance(doc, dict):
+            skipped.append({"file": name, "reason": "not an object"})
+            continue
+        payload = doc
+        if "parsed" in doc:  # r01–r04 wrapped shape
+            payload = doc["parsed"]
+            if not isinstance(payload, dict) or doc.get("rc"):
+                skipped.append(
+                    {
+                        "file": name,
+                        "reason": f"failed run (rc={doc.get('rc')})",
+                    }
+                )
+                continue
+        if payload.get("kind") == "backend_mismatch" or (
+            payload.get("error") and payload.get("metric") is None
+        ):
+            skipped.append(
+                {
+                    "file": name,
+                    "reason": (
+                        f"structured failure: "
+                        f"{payload.get('kind') or payload.get('error')}"
+                    ),
+                }
+            )
+            continue
+        pairs = _metric_rows(payload)
+        if not pairs:
+            skipped.append(
+                {"file": name, "reason": "no metric rows (dryrun/capture)"}
+            )
+            continue
+        backend = _infer_backend(doc, payload)
+        devices = _device_count(doc, payload)
+        for row, headline in pairs:
+            metric = str(row["metric"])
+            try:
+                value = float(row["value"])
+            except (TypeError, ValueError):
+                continue
+            meta = row.get("meta")
+            rows.append(
+                {
+                    "file": name,
+                    "round": rnd,
+                    "metric": metric,
+                    "value": value,
+                    "unit": row.get("unit", ""),
+                    "family": family_of(metric),
+                    "direction": direction_of(metric, row.get("unit", "")),
+                    "backend": (
+                        str(meta["backend"])
+                        if isinstance(meta, dict) and meta.get("backend")
+                        else backend
+                    ),
+                    "devices": (
+                        int(row["devices"])
+                        if row.get("devices")
+                        else devices
+                    ),
+                    "headline": headline,
+                }
+            )
+    return rows, skipped
+
+
+# --- trajectory + gate ------------------------------------------------------
+
+
+def build_groups(rows: list[dict]) -> list[dict]:
+    """Group rows by (family, metric, backend, devices); compute
+    best-known / latest / regression."""
+    by_key: dict[tuple, list[dict]] = {}
+    for r in rows:
+        by_key.setdefault(
+            (r["family"], r["metric"], r["backend"], r["devices"]), []
+        ).append(r)
+    groups = []
+    for (fam, metric, backend, devices), rs in sorted(by_key.items()):
+        rs = sorted(rs, key=lambda r: r["round"])
+        latest = rs[-1]
+        direction = latest["direction"]
+        if direction == "higher":
+            best = max(rs, key=lambda r: r["value"])
+            reg = (
+                (best["value"] - latest["value"]) / best["value"]
+                if best["value"]
+                else 0.0
+            )
+        else:
+            best = min(rs, key=lambda r: r["value"])
+            reg = (
+                (latest["value"] - best["value"]) / best["value"]
+                if best["value"]
+                else 0.0
+            )
+        groups.append(
+            {
+                "family": fam,
+                "metric": metric,
+                "backend": backend,
+                "devices": devices,
+                "direction": direction,
+                "n_rows": len(rs),
+                "best": best["value"],
+                "best_round": best["round"],
+                "latest": latest["value"],
+                "latest_round": latest["round"],
+                "headline": latest["headline"],
+                # positive = latest is worse than best-known
+                "regression": round(max(0.0, reg), 4),
+            }
+        )
+    return groups
+
+
+def check_gate(
+    groups: list[dict], threshold: float, strict: bool = False
+) -> tuple[list[dict], list[dict]]:
+    """(failures, warnings): tier-1 headline regressions past the
+    threshold fail; extra-metric regressions warn (fail iff strict)."""
+    failures, warnings = [], []
+    for g in groups:
+        if g["regression"] <= threshold:
+            continue
+        if g["n_rows"] < 2:
+            continue  # a single capture cannot regress against itself
+        if g["family"] in TIER1_FAMILIES and g["headline"]:
+            failures.append(g)
+        elif g["family"] in TIER1_FAMILIES:
+            (failures if strict else warnings).append(g)
+    return failures, warnings
+
+
+# --- rendering --------------------------------------------------------------
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.4g}" if abs(v) < 1 else f"{v:,.1f}"
+
+
+def render_md(
+    groups: list[dict],
+    skipped: list[dict],
+    files: list[str],
+    threshold: float,
+) -> str:
+    lines = [
+        "# Bench trajectory (tools/bench_trend.py)",
+        "",
+        f"Ingested {len(files)} artifacts; rows compare ONLY within "
+        "their (family, metric, backend, devices) group — CPU rows "
+        "never judge TPU captures or vice versa. `Δbest` is how far "
+        "the latest capture sits from the best-known on the same "
+        f"backend (gate threshold {threshold:.0%} on tier-1 headline "
+        "rows).",
+        "",
+    ]
+    by_family: dict[str, list[dict]] = {}
+    for g in groups:
+        by_family.setdefault(g["family"], []).append(g)
+    for fam in sorted(by_family):
+        gs = by_family[fam]
+        tier = "tier-1" if fam in TIER1_FAMILIES else "info"
+        lines.append(f"## {fam} ({tier})")
+        lines.append("")
+        lines.append(
+            "| metric | backend | dev | dir | best (r) | latest (r) "
+            "| Δbest |"
+        )
+        lines.append("|---|---|---|---|---|---|---|")
+        for g in gs:
+            delta = (
+                f"**-{g['regression']:.1%}**"
+                if g["regression"] > threshold and g["n_rows"] > 1
+                else (
+                    f"-{g['regression']:.1%}"
+                    if g["regression"] > 0
+                    else "="
+                )
+            )
+            mark = "" if g["headline"] else " *(extra)*"
+            lines.append(
+                f"| {g['metric']}{mark} | {g['backend']} | "
+                f"{g['devices']} | {g['direction']} | "
+                f"{_fmt(g['best'])} (r{g['best_round']:02d}) | "
+                f"{_fmt(g['latest'])} (r{g['latest_round']:02d}) | "
+                f"{delta} |"
+            )
+        lines.append("")
+    if skipped:
+        lines.append("## Skipped artifacts")
+        lines.append("")
+        for s in skipped:
+            lines.append(f"- `{s['file']}`: {s['reason']}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="bench-artifact trajectory + backend-partitioned "
+        "regression gate"
+    )
+    ap.add_argument(
+        "files",
+        nargs="*",
+        help="extra artifact files appended to the --dir scan "
+        "(synthetic rows, out-of-tree captures)",
+    )
+    ap.add_argument(
+        "--dir",
+        default=REPO_ROOT,
+        help="directory scanned for BENCH_r*.json / MULTICHIP_r*.json "
+        "(default: repo root)",
+    )
+    ap.add_argument(
+        "--no-scan",
+        action="store_true",
+        help="ingest ONLY the positional files (skip the --dir scan)",
+    )
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.15,
+        help="regression fraction that fails --check (default 0.15)",
+    )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="exit non-zero on tier-1 headline regressions past the "
+        "threshold",
+    )
+    ap.add_argument(
+        "--strict",
+        action="store_true",
+        help="--check also fails on extra-metric regressions",
+    )
+    ap.add_argument(
+        "--write",
+        action="store_true",
+        help="write TREND.md + TREND.json into --dir",
+    )
+    ap.add_argument("--json", action="store_true", help="print TREND.json")
+    args = ap.parse_args()
+
+    files: list[str] = []
+    if not args.no_scan:
+        files += sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
+        files += sorted(
+            glob.glob(os.path.join(args.dir, "MULTICHIP_r*.json"))
+        )
+    files += args.files
+    if not files:
+        print("no artifacts found", file=sys.stderr)
+        return 2
+
+    rows, skipped = ingest(files)
+    groups = build_groups(rows)
+    failures, warnings = check_gate(
+        groups, args.threshold, strict=args.strict
+    )
+    doc = {
+        "schema": "tm-tpu/bench-trend/v1",
+        "threshold": args.threshold,
+        "files": [os.path.basename(f) for f in files],
+        "rows": rows,
+        "groups": groups,
+        "skipped": skipped,
+        "check": {
+            "failures": failures,
+            "warnings": warnings,
+            "ok": not failures,
+        },
+    }
+    md = render_md(groups, skipped, files, args.threshold)
+
+    if args.write:
+        with open(os.path.join(args.dir, "TREND.md"), "w") as f:
+            f.write(md + "\n")
+        with open(os.path.join(args.dir, "TREND.json"), "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(
+            f"wrote {os.path.join(args.dir, 'TREND.md')} and TREND.json",
+            file=sys.stderr,
+        )
+    elif args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        print(md)
+
+    for w in warnings:
+        print(
+            f"# WARN extra-metric regression: {w['metric']} "
+            f"[{w['backend']} x{w['devices']}] best {_fmt(w['best'])} "
+            f"(r{w['best_round']:02d}) -> latest {_fmt(w['latest'])} "
+            f"(r{w['latest_round']:02d}), -{w['regression']:.1%}",
+            file=sys.stderr,
+        )
+    if args.check:
+        if failures:
+            for g in failures:
+                print(
+                    f"# FAIL tier-1 regression: {g['metric']} "
+                    f"[{g['backend']} x{g['devices']}] best "
+                    f"{_fmt(g['best'])} (r{g['best_round']:02d}) -> "
+                    f"latest {_fmt(g['latest'])} "
+                    f"(r{g['latest_round']:02d}), -{g['regression']:.1%} "
+                    f"> {args.threshold:.0%}",
+                    file=sys.stderr,
+                )
+            return 1
+        print(
+            f"# bench-trend check ok: {len(groups)} metric groups, "
+            f"{len(warnings)} extra-metric warnings, 0 tier-1 headline "
+            f"regressions",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,7 +28,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tendermint_tpu.obs import pacing_decisions, wall_attribution
+from tendermint_tpu.obs import (
+    FAMILY_WALL_SPANS,
+    pacing_decisions,
+    wall_attribution,
+)
 from tools.trace_report import extract_records
 
 
@@ -39,9 +43,11 @@ def _load(path: str):
         return json.load(f)
 
 
-def report(records: list[dict], n_heights: int = 64) -> dict:
+def report(
+    records: list[dict], n_heights: int = 64, family: str = "consensus"
+) -> dict:
     return {
-        "wall": wall_attribution(records, n_heights),
+        "wall": wall_attribution(records, n_heights, family=family),
         "pacing": pacing_decisions(records),
     }
 
@@ -113,6 +119,15 @@ def main() -> int:
         "--heights", type=int, default=64, help="max heights to report"
     )
     ap.add_argument(
+        "--family",
+        choices=sorted(FAMILY_WALL_SPANS),
+        default="consensus",
+        help="wall-attribution span classification: 'consensus' (cs.* "
+        "step spans; also the committee_scale bench family) or "
+        "'sequencer' (seq.* spans of the BlockV2 streaming plane, "
+        "heights are V2 heights)",
+    )
+    ap.add_argument(
         "--json", action="store_true", help="machine-readable output"
     )
     args = ap.parse_args()
@@ -125,7 +140,9 @@ def main() -> int:
             if isinstance(doc, dict) and doc.get("moniker")
             else (os.path.splitext(os.path.basename(path))[0] if path != "-" else "stdin")
         )
-        out[name] = report(extract_records(doc), args.heights)
+        out[name] = report(
+            extract_records(doc), args.heights, family=args.family
+        )
     if args.json:
         print(json.dumps(out, indent=1, sort_keys=True))
     else:

@@ -55,6 +55,28 @@ def _collect_dumps(handles, chaos_tracer) -> list[dict]:
     return dumps
 
 
+def _health_statuses(handles) -> dict:
+    """Per-node rolled-up health status — the one-line summary every
+    iteration carries (a chaos run that degrades a subsystem without
+    diverging still shows up here)."""
+    return {
+        h.name: obs.VERDICT_NAMES[h.cs.health.status()]
+        for h in handles
+        if getattr(h.cs, "health", None) is not None
+    }
+
+
+def _health_verdicts(handles) -> dict:
+    """Full per-node health verdicts (detector SLO state + incident
+    log) for the divergence artifact: next to the merged trace the
+    verdict says WHICH plane degraded before the fork/stall."""
+    return {
+        h.name: h.cs.health.verdict()
+        for h in handles
+        if getattr(h.cs, "health", None) is not None
+    }
+
+
 def _merge(dumps: list[dict]):
     """Rebase the dumps onto one timeline with explicit wall-anchor
     offsets — one process, one clock, so the anchors ARE ground truth
@@ -83,10 +105,18 @@ async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
     tracer.enabled = True
     tracer.clear()
 
+    # each node also carries a live health plane: the quorum-lag /
+    # round-churn / stall detectors watch the same run the chaos
+    # scenario shapes, incidents land in the per-node rings (so the
+    # divergence dump says WHY next to WHAT), and the final verdicts
+    # ride the artifact
     handles = build_chaos_handles(
         n_nodes,
         tracer_factory=lambda name: obs.Tracer(enabled=True),
         ping_interval=1.0,
+        health_factory=lambda name, node_tracer: obs.HealthMonitor(
+            tracer=node_tracer
+        ),
     )
     scenario = random_scenario(seed, [h.name for h in handles])
     runner = ScenarioRunner(handles, scenario)
@@ -107,12 +137,14 @@ async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
             "heights": {k: (v[-1] if v else 0) for k, v in heights.items()},
             "forks": len(hashes),
             "latency_attribution": obs.attribution(all_records),
+            "health": _health_statuses(handles),
             "plan": runner.plan_jsonl().decode(),
         }
         if not converged:
             merge = _merge(dumps)
             out["trace_report"] = obs.ascii_timeline(merge[2])
             out["cluster_report"] = obs.cluster_report(dumps, merge=merge)
+            out["health_verdicts"] = _health_verdicts(handles)
         return out
     except TimeoutError as e:
         dumps = _collect_dumps(handles, tracer)
@@ -124,6 +156,8 @@ async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
             "latency_attribution": obs.attribution(merge[2]),
             "trace_report": obs.ascii_timeline(merge[2]),
             "cluster_report": obs.cluster_report(dumps, merge=merge),
+            "health": _health_statuses(handles),
+            "health_verdicts": _health_verdicts(handles),
             "plan": runner.plan_jsonl().decode(),
         }
     finally:
