@@ -342,12 +342,19 @@ class SchedulerConfig:
     # Off by default: short-lived/test nodes shouldn't pay the ladder.
     prewarm: bool = False
     prewarm_manifest: str = "data/prewarm_manifest.json"
+    # recent-round telemetry ring (scheduler.dispatch_log). Debug view
+    # only: entries past the cap age out silently, so stats tooling
+    # reads the device-cost LEDGER (obs/ledger.py, never truncates)
+    # instead — PR 8 hit this cap reading dispatch stats from the ring
+    dispatch_log_size: int = 1024
 
     def validate_basic(self) -> None:
         if self.max_batch < 1:
             raise ValueError("scheduler.max_batch must be >= 1")
         if self.mesh_min_rows < 1:
             raise ValueError("scheduler.mesh_min_rows must be >= 1")
+        if self.dispatch_log_size < 1:
+            raise ValueError("scheduler.dispatch_log_size must be >= 1")
         ladder = self.ladder()
         if ladder is not None and (not ladder or min(ladder) < 1):
             raise ValueError(
@@ -457,6 +464,15 @@ class HealthConfig:
     # verify-scheduler queue depth that counts as saturated when the
     # sampling interval also shows full/no dispatch progress
     scheduler_depth_floor: int = 256
+    # dispatch fill-efficiency floor (obs/ledger.py seam): ticks whose
+    # interval fill (rows-requested / rows-dispatched) falls under
+    # fill_floor are bad events, judged only when the interval moved at
+    # least fill_min_rows dispatched rows — a saturated scheduler
+    # running 10%-full buckets is a ladder/mesh_min_rows
+    # misconfiguration worth paging on; a small committee's tiny padded
+    # vote rounds are not
+    fill_floor: float = 0.1
+    fill_min_rows: int = 256
     # WAL fsync drift: interval-mean latency beyond this multiple of
     # the learned good-sample median flags
     fsync_drift_factor: float = 4.0
@@ -483,6 +499,10 @@ class HealthConfig:
             )
         if not (0.0 < self.cache_hit_floor < 1.0):
             raise ValueError("health.cache_hit_floor must be in (0, 1)")
+        if not (0.0 < self.fill_floor < 1.0):
+            raise ValueError("health.fill_floor must be in (0, 1)")
+        if self.fill_min_rows < 1:
+            raise ValueError("health.fill_min_rows must be >= 1")
         for f in (
             "quorum_lag_floor",
             "quorum_lag_margin",

@@ -712,6 +712,30 @@ class SchedulerMetrics:
             "verify_dispatch_sharded_total",
             "Device verify rounds row-sharded across > 1 mesh device",
         )
+        # --- device-cost ledger surface (obs/ledger.py): raw tm_* names
+        # are the contract the capacity dashboards key on — per-class
+        # device-time shares and fill efficiency are the numbers that
+        # price the accelerator (the verify-as-a-service billing seam)
+        self.device_seconds = reg.counter(
+            "tm_scheduler_device_seconds_total",
+            "Device-execute seconds attributed per submitter class "
+            "(a coalesced round's wall splits by row share)",
+            ("klass",),
+            raw=True,
+        )
+        self.fill_ratio = reg.gauge(
+            "tm_scheduler_fill_ratio",
+            "rows-requested / rows-dispatched of the most recent round "
+            "that carried this class (1.0 = no padding waste)",
+            ("klass",),
+            raw=True,
+        )
+        self.padding_rows = reg.counter(
+            "tm_scheduler_padding_rows_total",
+            "Padded bucket rows dispatched beyond the rows requested "
+            "(device work bought by shape discipline and discarded)",
+            raw=True,
+        )
 
 
 class LightServeMetrics:

@@ -262,6 +262,13 @@ class Node(Service):
             )
         )
         consensus_metrics = ConsensusMetrics(self.metrics_registry)
+        # on-demand profiling hooks (obs/profiler.py): armed over the
+        # profile_start/profile_stop RPC routes, artifacts land in
+        # data/profiles — a live TPU session is minable without a
+        # redeploy. Construction is free; nothing runs until armed.
+        self.profiler = obs.ProfileCapture(
+            config.path("data/profiles"), logger=self.logger
+        )
 
         # --- live health plane (obs/health.py): streaming detectors
         # over the seams below; built BEFORE consensus so the arrival-
@@ -470,11 +477,16 @@ class Node(Service):
                 VerifyScheduler(
                     max_batch=config.scheduler.max_batch,
                     logger=self.logger,
+                    dispatch_log_size=config.scheduler.dispatch_log_size,
                 )
             )
             if self.health_monitor is not None:
                 self.health_monitor.bind_scheduler(
                     self.verify_scheduler.metrics
+                )
+                # fill-efficiency floor reads the device-cost ledger
+                self.health_monitor.bind_ledger(
+                    self.verify_scheduler.ledger
                 )
         # commit pipeline (consensus/commit_pipeline.py): group-commit
         # WAL + write-behind block store + background apply. All three
@@ -926,6 +938,14 @@ class Node(Service):
             await self.verify_scheduler.stop()
         if self.health_monitor is not None:
             await self.health_monitor.stop()
+        # an armed profile session must not outlive the node: stop it so
+        # the loop-profile artifact lands and the sampler thread exits
+        if getattr(self, "profiler", None) is not None and self.profiler.active:
+            try:
+                self.profiler.stop()
+            except Exception as e:
+                self.logger.error("profile stop at shutdown failed",
+                                  err=repr(e))
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self.metrics_server is not None:

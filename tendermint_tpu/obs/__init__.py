@@ -19,6 +19,12 @@ from .health import (
     BurnRateSLO,
     HealthMonitor,
 )
+from .ledger import (
+    DispatchLedger,
+    default_ledger,
+    set_default_ledger,
+)
+from .profiler import ProfileCapture, ProfilerUnavailable
 from .quantile import StreamingQuantile
 from .report import (
     FAMILY_WALL_SPANS,
@@ -46,7 +52,10 @@ __all__ = [
     "VERDICT_NAMES",
     "WARN",
     "BurnRateSLO",
+    "DispatchLedger",
     "HealthMonitor",
+    "ProfileCapture",
+    "ProfilerUnavailable",
     "SpanRecord",
     "StreamingQuantile",
     "Tracer",
@@ -54,6 +63,7 @@ __all__ = [
     "attribution",
     "attribution_table",
     "cluster_report",
+    "default_ledger",
     "default_tracer",
     "estimate_offsets",
     "flight_snapshot",
@@ -62,6 +72,7 @@ __all__ = [
     "normalize_dump",
     "pacing_decisions",
     "report_text",
+    "set_default_ledger",
     "set_default_tracer",
     "side_by_side_timeline",
     "wall_attribution",

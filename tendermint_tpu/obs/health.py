@@ -389,6 +389,40 @@ class SchedulerSaturationDetector(Detector):
         self._observe(t, queue_depth, bad=saturated)
 
 
+class FillEfficiencyDetector(Detector):
+    """Dispatch fill-efficiency floor over the device-cost ledger
+    (obs/ledger.py): each monitor tick with meaningful dispatch volume
+    computes interval fill = rows-requested / rows-dispatched; fill
+    under the floor is a bad event. A scheduler sustaining 10%-full
+    buckets is paying the device for padding — a ladder/mesh_min_rows/
+    max_batch misconfiguration the health plane should page on, not a
+    bench-archaeology finding. `min_rows` gates the judgement: a small
+    committee's vote rounds (a handful of rows padded to the 8-bucket)
+    are a latency choice, not waste worth paging over."""
+
+    subsystem = "scheduler"
+    name = "fill_efficiency"
+
+    def __init__(
+        self,
+        slo: BurnRateSLO,
+        floor: float = 0.1,
+        min_rows: int = 256,
+    ):
+        super().__init__(slo)
+        self.floor = floor
+        self.min_rows = min_rows
+        self.last_threshold = floor
+
+    def observe_interval(
+        self, t: float, rows_requested: float, rows_dispatched: float
+    ) -> None:
+        if rows_dispatched < self.min_rows:
+            return  # idle / small-round interval: nothing to judge
+        fill = rows_requested / rows_dispatched
+        self._observe(t, fill, bad=fill < self.floor)
+
+
 class LatencyDriftDetector(Detector):
     """Latency drift against a learned good baseline (WAL fsync is the
     canonical instance: a degrading disk shows up as the interval-mean
@@ -548,6 +582,8 @@ class HealthMonitor:
         quorum_lag_floor_s: float = 0.025,
         quorum_lag_margin: float = 2.0,
         scheduler_depth_floor: int = 256,
+        fill_floor: float = 0.1,
+        fill_min_rows: int = 256,
         fsync_drift_factor: float = 4.0,
         sequencer_apply_target_s: float = 0.1,
         cache_hit_floor: float = 0.9,
@@ -596,6 +632,11 @@ class HealthMonitor:
             slo("scheduler_saturation", objective=0.8),
             depth_floor=scheduler_depth_floor,
         )
+        self.fill_efficiency = FillEfficiencyDetector(
+            slo("fill_efficiency", objective=0.8),
+            floor=fill_floor,
+            min_rows=fill_min_rows,
+        )
         self.wal_fsync_drift = LatencyDriftDetector(
             slo("wal_fsync_drift", objective=0.8),
             drift_factor=fsync_drift_factor,
@@ -625,6 +666,7 @@ class HealthMonitor:
                 self.stalled_round,
                 self.quorum_lag,
                 self.scheduler_saturation,
+                self.fill_efficiency,
                 self.wal_fsync_drift,
                 self.sequencer_apply,
                 self.lightserve_hit_rate,
@@ -638,6 +680,7 @@ class HealthMonitor:
         self.incidents: deque[dict] = deque(maxlen=256)
         # pull-seam bindings + last-seen cumulative counts for deltas
         self._scheduler_metrics = None
+        self._ledger = None
         self._wal_hist = None
         self._sequencer_hist = None
         self._lightserve_metrics = None
@@ -660,6 +703,8 @@ class HealthMonitor:
             quorum_lag_floor_s=hc.quorum_lag_floor,
             quorum_lag_margin=hc.quorum_lag_margin,
             scheduler_depth_floor=hc.scheduler_depth_floor,
+            fill_floor=hc.fill_floor,
+            fill_min_rows=hc.fill_min_rows,
             fsync_drift_factor=hc.fsync_drift_factor,
             sequencer_apply_target_s=hc.sequencer_apply_target,
             cache_hit_floor=hc.cache_hit_floor,
@@ -708,6 +753,12 @@ class HealthMonitor:
 
     def bind_scheduler(self, scheduler_metrics) -> None:
         self._scheduler_metrics = scheduler_metrics
+
+    def bind_ledger(self, ledger) -> None:
+        """obs.ledger.DispatchLedger (or anything with totals()): the
+        fill-efficiency floor detector reads interval deltas of
+        rows-requested/rows-dispatched."""
+        self._ledger = ledger
 
     def bind_wal(self, fsync_histogram) -> None:
         """consensus_metrics.wal_fsync_seconds (or any Histogram)."""
@@ -758,6 +809,7 @@ class HealthMonitor:
         now = self.clock() if t is None else t
         for seam, pull in (
             ("scheduler", self._pull_scheduler),
+            ("ledger", self._pull_ledger),
             ("wal", self._pull_wal),
             ("sequencer", self._pull_sequencer),
             ("lightserve", self._pull_lightserve),
@@ -783,6 +835,16 @@ class HealthMonitor:
             self.scheduler_saturation.observe_sample(
                 now, depth, fill, int(ddisp)
             )
+
+    def _pull_ledger(self, now: float) -> None:
+        led = self._ledger
+        if led is None:
+            return
+        totals = led.totals()
+        dreq = self._delta("ledger_req", totals["rows_requested"])
+        ddisp = self._delta("ledger_disp", totals["rows_dispatched"])
+        if dreq is not None and ddisp is not None and ddisp > 0:
+            self.fill_efficiency.observe_interval(now, dreq, ddisp)
 
     def _pull_wal(self, now: float) -> None:
         if self._wal_hist is None:

@@ -65,6 +65,7 @@ from ..crypto.shape_registry import default_shape_registry
 from ..libs.log import Logger, nop_logger
 from ..libs.metrics import SchedulerMetrics, default_metrics
 from ..obs import default_tracer
+from ..obs.ledger import DispatchLedger, default_ledger
 
 # Priority classes, served strictly in this order when assembling a
 # round: live consensus votes must never queue behind a blocksync/light
@@ -168,11 +169,18 @@ class VerifyScheduler:
         max_batch: int = DEFAULT_MAX_BATCH,
         logger: Optional[Logger] = None,
         metrics: Optional[SchedulerMetrics] = None,
+        ledger: Optional[DispatchLedger] = None,
+        dispatch_log_size: int = 1024,
     ):
         self.verifier = verifier or default_verifier()
         self.max_batch = max(1, int(max_batch))
         self.logger = logger or nop_logger()
         self.metrics = metrics or default_metrics(SchedulerMetrics)
+        # device-cost ledger (obs/ledger.py): every round lands there
+        # as a structured entry with per-class rows, fill, queue-wait/
+        # host-prep/device-execute seconds. Process default unless a
+        # test isolates with its own instance.
+        self.ledger = ledger if ledger is not None else default_ledger()
         self._queues: dict[str, deque[_Submission]] = {
             k: deque() for k in CLASS_ORDER
         }
@@ -182,9 +190,15 @@ class VerifyScheduler:
         self._accepting = False
         self._prep_pool: Optional[ThreadPoolExecutor] = None
         self._dispatch_pool: Optional[ThreadPoolExecutor] = None
-        # telemetry for tests/debugging: recent rounds as
-        # {n, subs, classes, fill} dicts (bounded)
-        self.dispatch_log: deque = deque(maxlen=1024)
+        # telemetry for tests/debugging ONLY: recent rounds as
+        # {n, subs, classes, fill} dicts, bounded at dispatch_log_size
+        # ([scheduler] dispatch_log_size, default 1024) — entries past
+        # the cap silently age out, so the LEDGER above, whose totals
+        # never truncate, is the accounting source of truth (PR 8 hit
+        # the 1024-cap reading stats from this ring)
+        self.dispatch_log: deque = deque(
+            maxlen=max(1, int(dispatch_log_size))
+        )
 
     # --- lifecycle ---------------------------------------------------------
 
@@ -420,7 +434,7 @@ class VerifyScheduler:
                 prep = await self._host_prep(loop, round_)
                 if prep is None:
                     continue  # prep failed; futures already resolved
-                run, devices = prep
+                run, devices, prep_s = prep
                 # serialize device rounds: round N completes (and its
                 # verdicts resolve) before round N+1 dispatches — while
                 # N executes, the loop above already prepped N+1
@@ -428,7 +442,7 @@ class VerifyScheduler:
                     await inflight
                     inflight = None
                 inflight = loop.create_task(
-                    self._execute(round_, run, devices)
+                    self._execute(round_, run, devices, prep_s)
                 )
         except asyncio.CancelledError:
             pass  # forced cancel (loop teardown): fall through to drain
@@ -443,12 +457,12 @@ class VerifyScheduler:
     async def _host_prep(self, loop, round_):
         """Stage 1 of the pipeline: host-side batch assembly (padding,
         sign-bytes challenge hashing) on the prep thread. Returns
-        (device-run callable, mesh device count of the dispatch), or
-        None after resolving failures."""
+        (device-run callable, mesh device count of the dispatch,
+        host-prep seconds), or None after resolving failures."""
         kind = round_[0]
         if kind == "fn":
             sub = round_[1]
-            return (lambda: sub.fn(sub.items)), 1
+            return (lambda: sub.fn(sub.items)), 1, 0.0
         _, slices, total = round_
         flat: list[SigItem] = []
         for sub, lo, take in slices:
@@ -457,7 +471,7 @@ class VerifyScheduler:
         if prep_fn is None:
             # plain .verify-only verifier (test stubs): no split, the
             # whole call runs on the dispatch thread
-            return (lambda: self.verifier.verify(flat)), 1
+            return (lambda: self.verifier.verify(flat)), 1, 0.0
         t0 = time.perf_counter()
         try:
             prepared = await loop.run_in_executor(
@@ -467,15 +481,18 @@ class VerifyScheduler:
             self.logger.error("verify host prep failed", err=repr(e))
             self._fail_slices(slices, e)
             return None
+        prep_s = time.perf_counter() - t0
         default_tracer().add_span(
             "scheduler.host_prep",
             t0,
-            time.perf_counter() - t0,
+            prep_s,
             n=total,
         )
-        return prepared.run, getattr(prepared, "devices", 1)
+        return prepared.run, getattr(prepared, "devices", 1), prep_s
 
-    async def _execute(self, round_, run, devices: int = 1) -> None:
+    async def _execute(
+        self, round_, run, devices: int = 1, prep_s: float = 0.0
+    ) -> None:
         loop = asyncio.get_running_loop()
         kind = round_[0]
         tracer = default_tracer()
@@ -501,6 +518,20 @@ class VerifyScheduler:
                 sub.future.set_result(verdicts)
             self.dispatch_log.append(
                 {"n": sub.n, "subs": 1, "classes": [sub.klass], "fn": True}
+            )
+            wait = t0 - sub.t_enq
+            self.metrics.device_seconds.inc(dur, klass=sub.klass)
+            self.ledger.record_round(
+                t0,
+                class_rows={sub.klass: sub.n},
+                requested=sub.n,
+                dispatched=sub.n,  # fn lanes pad internally: no
+                # bucket waste attributable here
+                submissions=1,
+                queue_wait_s=wait,
+                class_queue_wait={sub.klass: wait},
+                device_s=dur,
+                engine="fn",
             )
             tracer.add_span(
                 "scheduler.device_round", t0, dur,
@@ -528,6 +559,37 @@ class VerifyScheduler:
         if n_subs >= 2:
             self.metrics.dispatch_coalesced.inc()
         self.metrics.batch_fill_ratio.set(round(fill, 4))
+        # device-cost ledger + the tm_scheduler_* accounting surface:
+        # rows/submissions/queue-wait per class, device time attributed
+        # by row share, padding = the bucket rows bought and discarded
+        class_rows: dict[str, int] = {}
+        class_subs: dict[str, int] = {}
+        class_wait: dict[str, float] = {}
+        for sub, _, take in slices:
+            class_rows[sub.klass] = class_rows.get(sub.klass, 0) + take
+            class_subs[sub.klass] = class_subs.get(sub.klass, 0) + 1
+            class_wait[sub.klass] = (
+                class_wait.get(sub.klass, 0.0) + (t0 - sub.t_enq)
+            )
+        for klass, rows in class_rows.items():
+            self.metrics.device_seconds.inc(
+                dur * (rows / total), klass=klass
+            )
+            self.metrics.fill_ratio.set(round(fill, 4), klass=klass)
+        self.metrics.padding_rows.inc(max(0, bucket - total))
+        self.ledger.record_round(
+            t0,
+            class_rows=class_rows,
+            requested=total,
+            dispatched=bucket,
+            devices=devices,
+            submissions=n_subs,
+            class_subs=class_subs,
+            queue_wait_s=t0 - oldest,
+            class_queue_wait=class_wait,
+            host_prep_s=prep_s,
+            device_s=dur,
+        )
         self.dispatch_log.append(
             {"n": total, "subs": n_subs, "classes": classes,
              "fill": round(fill, 4), "sharded": devices > 1,

@@ -93,6 +93,17 @@ class RPCCore:
             ),
             "dump_traces": self.dump_traces,
             "dump_health": self.dump_health,
+            "dump_dispatch_ledger": self.dump_dispatch_ledger,
+            # on-demand profiling hooks (obs/profiler.py), present when
+            # the node assembled a ProfileCapture
+            **(
+                {
+                    "profile_start": self.profile_start,
+                    "profile_stop": self.profile_stop,
+                }
+                if getattr(self.node, "profiler", None) is not None
+                else {}
+            ),
             "consensus_params": self.consensus_params,
             "tx": self.tx,
             "tx_search": self.tx_search,
@@ -152,6 +163,68 @@ class RPCCore:
         out = monitor.verdict()
         out["enabled"] = True
         return out
+
+    def dump_dispatch_ledger(self, entries=None, **_kw) -> dict:
+        """Device-cost ledger (obs/ledger.py): per-class device-seconds
+        and shares, fill-efficiency distribution, padding-waste totals,
+        requests-per-dispatch amortization, plus the newest structured
+        round entries (`entries` param, default 128) and the
+        shape-registry counters the totals reconcile against."""
+        from ..crypto.shape_registry import default_shape_registry
+        from ..obs.ledger import default_ledger
+
+        sched = getattr(self.node, "verify_scheduler", None)
+        ledger = sched.ledger if sched is not None else default_ledger()
+        try:
+            n = int(entries) if entries is not None else 128
+        except (TypeError, ValueError):
+            from .server import RPCError
+
+            raise RPCError(
+                -32602, "invalid entries: not an integer"
+            ) from None
+        return {
+            "enabled": sched is not None,
+            "summary": ledger.summary(),
+            # entries <= 0 means "summary only" (ledger.entries treats
+            # limit 0 as unlimited, which is the opposite of what a
+            # caller asking for zero entries wants)
+            "entries": ledger.entries(limit=n) if n > 0 else [],
+            "shape_registry": default_shape_registry().snapshot(),
+        }
+
+    def profile_start(self, label="", device=True, **_kw) -> dict:
+        """Arm an on-demand profiling session: a jax device trace
+        (guarded, CPU-backend tolerant — unavailability is reported
+        structurally inside `device_trace`, not an error) plus a
+        sampled event-loop profile, both landing under data/profiles.
+        A second start while one runs is a structured error."""
+        from ..obs.profiler import ProfilerUnavailable
+
+        try:
+            started = self.node.profiler.start(
+                label=str(label or ""),
+                device=device not in (False, "false", "0", 0),
+            )
+        except ProfilerUnavailable as e:
+            from .server import RPCError
+
+            raise RPCError(-32000, f"profiler unavailable: {e}") from None
+        return {"started": True, **started}
+
+    def profile_stop(self, **_kw) -> dict:
+        """Disarm the running session; returns artifact paths + the
+        loop profile's hottest stacks. No session running is a
+        structured error (the profiler-unavailable path)."""
+        from ..obs.profiler import ProfilerUnavailable
+
+        try:
+            session = self.node.profiler.stop()
+        except ProfilerUnavailable as e:
+            from .server import RPCError
+
+            raise RPCError(-32000, f"profiler unavailable: {e}") from None
+        return {"stopped": True, **session}
 
     def status(self) -> dict:
         n = self.node

@@ -362,15 +362,37 @@ async def stop_mesh(handles: list[NodeHandle]) -> None:
 def node_dump(handle: NodeHandle) -> dict:
     """A `dump_traces`-shaped dict for one in-proc node — the input
     obs.cluster/tools/cluster_trace.py consume. Only meaningful when the
-    mesh was built with per-node tracers (tracer_factory)."""
+    mesh was built with per-node tracers (tracer_factory). When the
+    node's verify path owns a scheduler (or was handed a ledger), its
+    device-cost summary rides along so a divergence artifact answers
+    "what was the device doing" without a repro run."""
     tracer = handle.cs.tracer
-    return {
+    out = {
         "node_id": handle.node_key.id,
         "moniker": handle.name,
         "epoch_wall_ns": tracer.epoch_wall_ns,
         "records": [r.to_json() for r in tracer.records()],
         "peer_clock": handle.switch.peer_clock_table(),
     }
+    ledger = node_ledger(handle)
+    if ledger is not None:
+        out["dispatch_ledger"] = ledger.summary()
+    return out
+
+
+def node_ledger(handle: NodeHandle):
+    """The DispatchLedger behind a handle's verify path, if any: a
+    scheduler-backed verifier (classed adapter or the scheduler itself)
+    or an explicitly attached `cs.dispatch_ledger`."""
+    cs = handle.cs
+    led = getattr(cs, "dispatch_ledger", None)
+    if led is not None:
+        return led
+    verifier = getattr(cs, "verifier", None)
+    sched = getattr(verifier, "_sched", None)  # _ClassedVerifier
+    if sched is None:
+        sched = getattr(cs, "verify_scheduler", None)
+    return getattr(sched, "ledger", None)
 
 
 async def chain_hashes(handles: list[NodeHandle], height: int) -> set:

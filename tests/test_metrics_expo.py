@@ -305,3 +305,75 @@ def test_gauge_dec_and_track_inprogress():
     except RuntimeError:
         pass
     assert g.value(klass="a") == 2
+
+
+# --- README metrics-reference drift (PR 12 satellite) ----------------------
+
+
+def _live_metric_families() -> set:
+    """Every family a fully-assembled node exports: one fresh registry,
+    every metric-set class a node (or its seams) constructs."""
+    from tendermint_tpu.libs import metrics as m
+
+    reg = Registry()
+    for cls in (
+        m.ConsensusMetrics,
+        m.P2PMetrics,
+        m.BlocksyncMetrics,
+        m.StateSyncMetrics,
+        m.RPCMetrics,
+        m.SchedulerMetrics,
+        m.LightServeMetrics,
+        m.SequencerMetrics,
+        m.HealthMetrics,
+        m.ProcessMetrics,
+        m.EvidenceMetrics,
+    ):
+        cls(reg)
+    return set(re.findall(r"^# TYPE (\S+) ", reg.render(), re.M))
+
+
+def test_readme_metrics_reference_matches_exposition():
+    """The README "Metrics reference" section must list exactly the
+    families a live node exports — the metric surface grew across PRs
+    2/5/11/12 with no check that the docs track it. A new family lands
+    with its doc line or fails here; a removed family takes its doc
+    line with it."""
+    live = _live_metric_families()
+    readme = os.path.join(os.path.dirname(__file__), "..", "README.md")
+    with open(readme) as f:
+        text = f.read()
+    m = re.search(
+        r"### Metrics reference\n(.*?)\n###", text, re.S
+    )
+    assert m, "README.md lost its '### Metrics reference' section"
+    documented = set(
+        re.findall(
+            r"`((?:tendermint|tm|process)_[a-z0-9_]+)`", m.group(1)
+        )
+    )
+    assert live == documented, (
+        f"README metrics reference drift: "
+        f"undocumented={sorted(live - documented)} "
+        f"stale={sorted(documented - live)}"
+    )
+
+
+def test_scheduler_ledger_metric_families_raw_names():
+    """The device-cost ledger surface exports under raw tm_ names (no
+    tendermint_ prefix): the capacity-dashboard contract."""
+    from tendermint_tpu.libs.metrics import SchedulerMetrics
+
+    reg = Registry()
+    sm = SchedulerMetrics(reg)
+    sm.device_seconds.inc(0.25, klass="consensus")
+    sm.fill_ratio.set(0.5, klass="consensus")
+    sm.padding_rows.inc(7)
+    body = reg.render()
+    assert (
+        'tm_scheduler_device_seconds_total{klass="consensus"} 0.25'
+        in body
+    )
+    assert 'tm_scheduler_fill_ratio{klass="consensus"} 0.5' in body
+    assert "tm_scheduler_padding_rows_total 7" in body
+    assert "tendermint_tm_scheduler" not in body

@@ -67,6 +67,15 @@ def test_http_ws_local_clients(tmp_path):
         assert dump["enabled"] is True
         assert "consensus" in dump["subsystems"]
         assert "quorum_lag" in dump["subsystems"]["consensus"]["detectors"]
+        # device-cost ledger route (PR 12): the dump carries the
+        # summary block, the recent entries, and the shape-registry
+        # counters it reconciles against
+        led = await ws.call("dump_dispatch_ledger")
+        assert led["enabled"] is True
+        assert "per_class" in led["summary"]
+        assert "fill_ratio_p50" in led["summary"]
+        assert "device_dispatch_count" in led["shape_registry"]
+        assert isinstance(led["entries"], list)
         events = await ws.subscribe("tm.event = 'NewBlock'")
         ev = await asyncio.wait_for(events.__anext__(), 30)
         assert ev["query"] == "tm.event = 'NewBlock'"
@@ -153,8 +162,10 @@ def test_openapi_doc_matches_route_table():
     class _N:
         # any assembled serving plane exposes the lightserve proof
         # routes; the doc describes the full surface, so the stub
-        # carries one
+        # carries one — likewise the profiler behind the
+        # profile_start/profile_stop hooks
         lightserve = object()
+        profiler = object()
 
         class config:
             class rpc:

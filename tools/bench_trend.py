@@ -55,6 +55,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # ordered prefix -> family (first match wins; longer prefixes first)
 _FAMILY_PREFIXES = (
+    ("scheduler_", "scheduler"),
     ("consensus_pacing", "consensus_pacing"),
     ("consensus_", "consensus"),
     ("lightserve", "lightserve"),
@@ -88,6 +89,10 @@ TIER1_FAMILIES = frozenset(
         "commit_path",
         "blocksync",
         "multichip",
+        # the device_cost fill/padding rows (never headline, so this
+        # only makes them warn-level / --strict-promotable instead of
+        # purely informational)
+        "scheduler",
     }
 )
 
@@ -112,6 +117,8 @@ _LOWER_TOKENS = (
 _DIRECTION_OVERRIDES = {
     "bls_aggregate_verify_1k": "lower",  # ms for a 1k-signer aggregate
     "light_bisection_1k": "higher",  # sigs/s on the 1k-validator chain
+    # padding fraction of dispatched rows (device_cost block): waste
+    "scheduler_padding_fraction": "lower",
 }
 
 
@@ -174,12 +181,50 @@ def _device_count(doc: dict, payload: dict) -> int:
     return 1
 
 
+def _ledger_rows(payload: dict) -> list[dict]:
+    """Synthesized extra-metric rows from a PR 12 `device_cost` block:
+    fill-efficiency percentiles + the padding fraction, warn-level like
+    every other extra metric (`--strict` promotes). Only emitted when
+    the family actually drove scheduler rounds — a zero-round block
+    would land fill 0.0 and cry regression forever."""
+    dc = payload.get("device_cost")
+    if not isinstance(dc, dict):
+        return []
+    # guard on SIG rounds: fn-lane rounds carry no bucket fill, so a
+    # span of only fn rounds would stamp fill 0.0 and cry regression
+    # against any prior real fill forever
+    if not (dc.get("rounds", 0) - dc.get("fn_rounds", 0)):
+        return []
+    rows = [
+        {
+            "metric": "scheduler_fill_ratio_p50",
+            "value": dc.get("fill_ratio_p50"),
+            "unit": "rows-requested/rows-dispatched per round, p50",
+        },
+        {
+            "metric": "scheduler_fill_ratio_p95",
+            "value": dc.get("fill_ratio_p95"),
+            "unit": "rows-requested/rows-dispatched per round, p95",
+        },
+    ]
+    disp = dc.get("rows_dispatched") or 0
+    if disp:
+        rows.append(
+            {
+                "metric": "scheduler_padding_fraction",
+                "value": round(dc.get("padding_rows", 0) / disp, 4),
+                "unit": "padding rows / dispatched rows",
+            }
+        )
+    return [r for r in rows if r["value"] is not None]
+
+
 def _metric_rows(payload: dict) -> list[tuple[dict, bool]]:
     """(row_dict, is_headline) pairs from one normalized payload."""
     rows = []
     if payload.get("metric") is not None and payload.get("value") is not None:
         rows.append((payload, True))
-    for e in payload.get("extra_metrics") or []:
+    for e in (payload.get("extra_metrics") or []) + _ledger_rows(payload):
         if (
             isinstance(e, dict)
             and e.get("metric") is not None

@@ -77,6 +77,27 @@ def _health_verdicts(handles) -> dict:
     }
 
 
+def _ledger_summaries(handles) -> dict:
+    """Per-node device-cost ledger summaries (obs/ledger.py) for the
+    divergence artifact: next to the health verdicts (WHICH plane
+    degraded) the ledger says what the DEVICE was doing — per-class
+    device-seconds, fill efficiency, padding waste. Harness nodes whose
+    verify path owns a scheduler report their own ledger; the process
+    default ledger rides as "_process" either way (the in-proc mesh
+    funnels any installed scheduler's rounds there), so a verify plane
+    that did nothing shows zero rounds honestly instead of being
+    absent."""
+    from tests.chaos_harness import node_ledger
+
+    out = {}
+    for h in handles:
+        led = node_ledger(h)
+        if led is not None:
+            out[h.name] = led.summary()
+    out["_process"] = obs.default_ledger().summary()
+    return out
+
+
 def _merge(dumps: list[dict]):
     """Rebase the dumps onto one timeline with explicit wall-anchor
     offsets — one process, one clock, so the anchors ARE ground truth
@@ -145,6 +166,7 @@ async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
             out["trace_report"] = obs.ascii_timeline(merge[2])
             out["cluster_report"] = obs.cluster_report(dumps, merge=merge)
             out["health_verdicts"] = _health_verdicts(handles)
+            out["dispatch_ledger"] = _ledger_summaries(handles)
         return out
     except TimeoutError as e:
         dumps = _collect_dumps(handles, tracer)
@@ -158,6 +180,7 @@ async def run_one(seed: int, n_nodes: int, height: int, timeout: float) -> dict:
             "cluster_report": obs.cluster_report(dumps, merge=merge),
             "health": _health_statuses(handles),
             "health_verdicts": _health_verdicts(handles),
+            "dispatch_ledger": _ledger_summaries(handles),
             "plan": runner.plan_jsonl().decode(),
         }
     finally:
