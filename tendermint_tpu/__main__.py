@@ -473,6 +473,23 @@ def cmd_probe_tpu(args) -> int:
     return 0
 
 
+def cmd_verify_service(args) -> int:
+    """Run the standalone verify-service process: one device-owning
+    scheduler serving a whole committee over UDS IPC
+    (parallel/verify_service.py, ROADMAP verify-as-a-service)."""
+    from .libs.log import default_logger
+    from .parallel.verify_service import run_service
+
+    return run_service(
+        args.socket,
+        max_batch=args.max_batch,
+        stats_port=args.stats_port if args.stats_port >= 0 else None,
+        prewarm=args.prewarm,
+        logger=default_logger(),
+        ready_fd=args.ready_fd if args.ready_fd >= 0 else None,
+    )
+
+
 def cmd_version(args) -> int:
     print(
         f"tendermint-tpu {TMCORE_SEM_VER} "
@@ -591,6 +608,37 @@ def main(argv=None) -> int:
         "probe-tpu", help="show devices + the [tpu] config mesh"
     )
     sp.set_defaults(fn=cmd_probe_tpu)
+
+    sp = sub.add_parser(
+        "verify-service",
+        help="run a standalone verify-service process (the device-"
+        "owning scheduler N nodes submit to over a unix socket; point "
+        "nodes at it with [scheduler] remote_socket)",
+    )
+    sp.add_argument(
+        "--socket", required=True, help="unix-domain socket path to serve"
+    )
+    sp.add_argument("--max-batch", type=int, default=16384)
+    sp.add_argument(
+        "--stats-port",
+        type=int,
+        default=-1,
+        help="TCP port for GET /metrics + /dump_dispatch_ledger "
+        "(0 = ephemeral, -1 = disabled)",
+    )
+    sp.add_argument(
+        "--prewarm",
+        action="store_true",
+        help="AOT-load the bucket-ladder verify programs before serving",
+    )
+    sp.add_argument(
+        "--ready-fd",
+        type=int,
+        default=-1,
+        help="fd that gets one JSON readiness line once the socket "
+        "accepts (harness use)",
+    )
+    sp.set_defaults(fn=cmd_verify_service)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

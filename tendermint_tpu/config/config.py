@@ -320,6 +320,17 @@ class SchedulerConfig:
     consensus > evidence > blocksync > light > lightserve."""
 
     enable: bool = True
+    # UDS path of a standalone verify-service process
+    # (`python -m tendermint_tpu verify-service`): when set, node
+    # assembly builds a RemoteVerifyScheduler CLIENT instead of an
+    # in-proc VerifyScheduler — this node's verify submissions coalesce
+    # with every other attached node's on the service's device plane
+    # (cross-PROCESS rounds), degrading to local dispatch whenever the
+    # socket is unreachable (parallel/verify_service.py). Relative
+    # paths resolve against the node home, so a rack of generated homes
+    # shares one absolute socket (tools/testnet_generator.py
+    # --verify-service).
+    remote_socket: str = ""
     # max signature items coalesced into one device round (the measured
     # bulk-tier throughput knee, PERF_ANALYSIS §10)
     max_batch: int = 16384
@@ -476,6 +487,11 @@ class HealthConfig:
     # WAL fsync drift: interval-mean latency beyond this multiple of
     # the learned good-sample median flags
     fsync_drift_factor: float = 4.0
+    # verify-service IPC drift ([scheduler] remote_socket deployments):
+    # interval-mean submit->verdict round trip beyond this multiple of
+    # the learned good-sample median flags; any local-degrade fallback
+    # in the interval is a bad event outright
+    ipc_drift_factor: float = 4.0
     # sequencer receipt->applied SLO target (PR 10 measured 96 ms p95;
     # snapped up to the nearest apply-latency histogram bucket, 0.1 s)
     sequencer_apply_target: float = 0.1
@@ -507,6 +523,7 @@ class HealthConfig:
             "quorum_lag_floor",
             "quorum_lag_margin",
             "fsync_drift_factor",
+            "ipc_drift_factor",
             "sequencer_apply_target",
             "loop_lag_warn",
             "stall_factor",

@@ -738,6 +738,44 @@ class SchedulerMetrics:
         )
 
 
+class RemoteSchedulerMetrics:
+    """parallel/verify_service.py — the RemoteVerifyScheduler client's
+    IPC health: how much verify work went over the wire, how often the
+    client fell back to local dispatch (the degradation contract made
+    countable), and the submit->verdict round-trip distribution the
+    ipc_round_trip health detector judges for drift. Raw tm_* names
+    like the device-cost surface — the verify-service capacity
+    dashboards key on them."""
+
+    def __init__(self, reg: Optional[Registry] = None):
+        reg = reg or default_registry()
+        self.submissions = reg.counter(
+            "tm_verify_remote_submissions_total",
+            "Submissions shipped to the verify service over IPC",
+            ("klass",),
+            raw=True,
+        )
+        self.degrades = reg.counter(
+            "tm_verify_remote_degrades_total",
+            "Submissions resolved by the LOCAL fallback verifier "
+            "(service unreachable or socket died mid-flight)",
+            raw=True,
+        )
+        self.reconnects = reg.counter(
+            "tm_verify_remote_reconnects_total",
+            "Successful (re)attachments to the verify service socket",
+            raw=True,
+        )
+        self.rtt_seconds = reg.histogram(
+            "tm_verify_remote_rtt_seconds",
+            "Submit->verdict IPC round trip (queue wait + device round "
+            "+ wire overhead as the client experiences it)",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                     float("inf")),
+            raw=True,
+        )
+
+
 class LightServeMetrics:
     """tendermint_tpu/lightserve — the light-client serving plane's
     proof-cache and shared-verify health (hit rate and dedup rate are

@@ -467,7 +467,34 @@ class Node(Service):
             from ..crypto.shape_registry import configure_default
 
             configure_default(ladder)
-        if config.scheduler.enable:
+        if config.scheduler.enable and config.scheduler.remote_socket:
+            # split-brain deployment ([scheduler] remote_socket): a
+            # standalone verify-service process owns the device plane;
+            # this node is a CLIENT whose submissions coalesce with the
+            # rest of the rack's (parallel/verify_service.py). The
+            # device-side fill/saturation seams live on the SERVICE
+            # (its own /metrics + dump_dispatch_ledger); this node's
+            # health plane watches the IPC round trip + degrades
+            # instead.
+            from ..parallel.scheduler import set_default_scheduler
+            from ..parallel.verify_service import RemoteVerifyScheduler
+
+            self.verify_scheduler = set_default_scheduler(
+                RemoteVerifyScheduler(
+                    config.path(config.scheduler.remote_socket),
+                    logger=self.logger,
+                    tracer=self.tracer,
+                )
+            )
+            self.logger.info(
+                "verify plane: remote service client",
+                socket=config.path(config.scheduler.remote_socket),
+            )
+            if self.health_monitor is not None:
+                self.health_monitor.bind_remote_scheduler(
+                    self.verify_scheduler
+                )
+        elif config.scheduler.enable:
             from ..parallel.scheduler import (
                 VerifyScheduler,
                 set_default_scheduler,

@@ -465,6 +465,67 @@ class LatencyDriftDetector(Detector):
             self._baseline.add(mean_s)
 
 
+class IpcRoundTripDetector(Detector):
+    """Verify-service IPC health ([scheduler] remote_socket nodes): the
+    RemoteVerifyScheduler's cumulative submit->verdict accounting
+    (`ipc_stats()`) is pulled per tick and judged two ways:
+
+    - the interval-mean round trip drifts off a learned good-sample
+      median (same asymmetry as the WAL fsync detector: bad intervals
+      never teach the baseline) — a wedged-but-open service, a
+      saturated device plane, or a socket path rerouted through a slow
+      filesystem all show up here BEFORE heights visibly inflate;
+    - every local-degrade fallback in the interval is a bad event
+      outright: the client never hangs and never drops a verdict, so
+      degrades are invisible to liveness — burn-rate on them is how a
+      dying service pages instead of silently billing every verify to
+      the local CPU."""
+
+    subsystem = "scheduler"
+    name = "ipc_round_trip"
+
+    def __init__(
+        self,
+        slo: BurnRateSLO,
+        drift_factor: float = 4.0,
+        abs_floor_s: float = 0.002,
+        baseline_window: int = 256,
+        min_baseline: int = 8,
+    ):
+        super().__init__(slo)
+        self.drift_factor = drift_factor
+        self.abs_floor_s = abs_floor_s
+        self.min_baseline = min_baseline
+        self._baseline = StreamingQuantile(window=baseline_window)
+
+    def threshold(self) -> float:
+        if len(self._baseline) < self.min_baseline:
+            return float("inf")
+        return max(
+            self.abs_floor_s,
+            self.drift_factor * self._baseline.quantile(0.5),
+        )
+
+    def observe_interval(
+        self,
+        t: float,
+        mean_rtt_s: Optional[float] = None,
+        degrades: int = 0,
+    ) -> None:
+        if degrades > 0:
+            # degraded submissions carry no RTT — each is its own bad
+            # event (last_bad stays the offending RTT if one was seen)
+            self.slo.observe(t, bad=degrades, total=degrades)
+        if mean_rtt_s is None:
+            return
+        thr = self.threshold()
+        self.last_threshold = thr if thr != float("inf") else 0.0
+        bad = mean_rtt_s > thr
+        self._observe(t, mean_rtt_s, bad=bad)
+        if not bad:
+            self._baseline.add(mean_rtt_s)
+
+
 class LatencySLODetector(Detector):
     """Fixed-target latency SLO over histogram-delta observations: the
     sequencer receipt->applied plane targets PR 10's measured 96 ms p95
@@ -585,6 +646,7 @@ class HealthMonitor:
         fill_floor: float = 0.1,
         fill_min_rows: int = 256,
         fsync_drift_factor: float = 4.0,
+        ipc_drift_factor: float = 4.0,
         sequencer_apply_target_s: float = 0.1,
         cache_hit_floor: float = 0.9,
         loop_lag_warn_s: float = 0.05,
@@ -641,6 +703,10 @@ class HealthMonitor:
             slo("wal_fsync_drift", objective=0.8),
             drift_factor=fsync_drift_factor,
         )
+        self.ipc_round_trip = IpcRoundTripDetector(
+            slo("ipc_round_trip", objective=0.8),
+            drift_factor=ipc_drift_factor,
+        )
         self.sequencer_apply = LatencySLODetector(
             slo("sequencer_apply_slo", objective=0.95, min_events=16),
             target_s=sequencer_apply_target_s,
@@ -668,6 +734,7 @@ class HealthMonitor:
                 self.scheduler_saturation,
                 self.fill_efficiency,
                 self.wal_fsync_drift,
+                self.ipc_round_trip,
                 self.sequencer_apply,
                 self.lightserve_hit_rate,
                 self.peer_flap,
@@ -681,6 +748,7 @@ class HealthMonitor:
         # pull-seam bindings + last-seen cumulative counts for deltas
         self._scheduler_metrics = None
         self._ledger = None
+        self._remote_scheduler = None
         self._wal_hist = None
         self._sequencer_hist = None
         self._lightserve_metrics = None
@@ -706,6 +774,7 @@ class HealthMonitor:
             fill_floor=hc.fill_floor,
             fill_min_rows=hc.fill_min_rows,
             fsync_drift_factor=hc.fsync_drift_factor,
+            ipc_drift_factor=getattr(hc, "ipc_drift_factor", 4.0),
             sequencer_apply_target_s=hc.sequencer_apply_target,
             cache_hit_floor=hc.cache_hit_floor,
             loop_lag_warn_s=hc.loop_lag_warn,
@@ -760,6 +829,13 @@ class HealthMonitor:
         rows-requested/rows-dispatched."""
         self._ledger = ledger
 
+    def bind_remote_scheduler(self, remote) -> None:
+        """parallel.verify_service.RemoteVerifyScheduler (or anything
+        with `ipc_stats()` returning cumulative rtt_count/rtt_sum_s/
+        degrades): the ipc_round_trip detector reads interval deltas —
+        mean RTT judged vs a learned baseline, degrades bad outright."""
+        self._remote_scheduler = remote
+
     def bind_wal(self, fsync_histogram) -> None:
         """consensus_metrics.wal_fsync_seconds (or any Histogram)."""
         self._wal_hist = fsync_histogram
@@ -810,6 +886,7 @@ class HealthMonitor:
         for seam, pull in (
             ("scheduler", self._pull_scheduler),
             ("ledger", self._pull_ledger),
+            ("remote_scheduler", self._pull_remote_scheduler),
             ("wal", self._pull_wal),
             ("sequencer", self._pull_sequencer),
             ("lightserve", self._pull_lightserve),
@@ -845,6 +922,22 @@ class HealthMonitor:
         ddisp = self._delta("ledger_disp", totals["rows_dispatched"])
         if dreq is not None and ddisp is not None and ddisp > 0:
             self.fill_efficiency.observe_interval(now, dreq, ddisp)
+
+    def _pull_remote_scheduler(self, now: float) -> None:
+        remote = self._remote_scheduler
+        if remote is None:
+            return
+        stats = remote.ipc_stats()
+        dcount = self._delta("ipc_rtt_count", stats["rtt_count"])
+        dsum = self._delta("ipc_rtt_sum", stats["rtt_sum_s"])
+        ddeg = self._delta("ipc_degrades", stats["degrades"])
+        if dcount is None or dsum is None or ddeg is None:
+            return  # first sample: baseline only
+        self.ipc_round_trip.observe_interval(
+            now,
+            mean_rtt_s=(dsum / dcount) if dcount > 0 else None,
+            degrades=int(ddeg),
+        )
 
     def _pull_wal(self, now: float) -> None:
         if self._wal_hist is None:

@@ -161,6 +161,45 @@ def stub_default_verifier():
         bv._default = saved
 
 
+class ChaosVerifyService:
+    """Kill-and-restart wrapper around the in-proc verify service
+    (parallel/verify_service.ServiceThread) — the chaos action for the
+    split-brain deployment: `kill()` tears the service down mid-flight
+    (clients' pending submissions must degrade to local verify, never
+    hang), `restart()` brings a fresh service up on the SAME socket
+    path (clients' backoff loops re-attach transparently). Constructor
+    kwargs pass through to VerifyServiceServer (inject a stub verifier
+    via `scheduler=VerifyScheduler(verifier=...)` to keep chaos runs
+    device-free)."""
+
+    def __init__(self, path: str, **kw):
+        self.path = path
+        self._kw = kw
+        self.service = None
+        self.restarts = 0
+
+    def start(self) -> None:
+        from tendermint_tpu.parallel.verify_service import ServiceThread
+
+        self.service = ServiceThread(self.path, **self._kw)
+        self.service.start()
+
+    def kill(self) -> None:
+        """Tear the service down (connections die, socket unlinks)."""
+        if self.service is not None:
+            self.service.stop()
+            self.service = None
+
+    def restart(self) -> None:
+        self.kill()
+        self.start()
+        self.restarts += 1
+
+    @property
+    def alive(self) -> bool:
+        return self.service is not None
+
+
 async def round_dissemination_ticks(
     n: int, batch: bool, chunk_max: int = 64
 ) -> dict:

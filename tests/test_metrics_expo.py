@@ -323,6 +323,7 @@ def _live_metric_families() -> set:
         m.StateSyncMetrics,
         m.RPCMetrics,
         m.SchedulerMetrics,
+        m.RemoteSchedulerMetrics,
         m.LightServeMetrics,
         m.SequencerMetrics,
         m.HealthMetrics,

@@ -55,6 +55,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # ordered prefix -> family (first match wins; longer prefixes first)
 _FAMILY_PREFIXES = (
+    ("verify_service", "verify_service"),
     ("scheduler_", "scheduler"),
     ("consensus_pacing", "consensus_pacing"),
     ("consensus_", "consensus"),
@@ -86,6 +87,9 @@ TIER1_FAMILIES = frozenset(
         "light",
         "committee_scale",
         "sequencer_stream",
+        # the split-brain verify plane (PR 13): headline is
+        # wall-per-height at 32 validators with real crypto over IPC
+        "verify_service",
         "commit_path",
         "blocksync",
         "multichip",

@@ -5,6 +5,11 @@ Reads any surface the device-cost ledger (obs/ledger.py) lands on:
 
 - a `dump_dispatch_ledger` RPC response (raw or `{"result": ...}`
   envelope) pulled from a live node,
+- a verify-service dump (the standalone service's own
+  /dump_dispatch_ledger or STATS frame, PR 13): same shape plus a
+  `per_client` tenant table — the multi-tenant device bill with real
+  tenants, rendered as per-client submission/row counts next to the
+  per-class cost shares,
 - a bench artifact carrying a `device_cost` block (every family stamps
   one since PR 12),
 - a bare `device_cost`/summary dict,
@@ -49,6 +54,11 @@ def extract_summary(doc: dict) -> dict:
     for key in ("summary", "device_cost"):
         block = doc.get(key)
         if isinstance(block, dict) and "rounds" in block:
+            # a verify-service dump carries the tenant table BESIDE the
+            # summary; attach it so the report can render the bill
+            if isinstance(doc.get("per_client"), dict):
+                block = dict(block)
+                block["per_client"] = doc["per_client"]
             return block
     if "rounds" in doc and "per_class" in doc:
         return doc  # already a bare summary
@@ -115,6 +125,35 @@ def report_text(summary: dict, name: str = "") -> str:
                 f"{acct.get('rounds', 0):>7} "
                 f"{acct.get('submissions', 0):>7} "
                 f"{_fmt_s(acct.get('queue_wait_seconds', 0.0)):>12}"
+            )
+    per_client = summary.get("per_client") or {}
+    if per_client:
+        total_rows = sum(
+            c.get("rows", 0) + c.get("fn_items", 0)
+            for c in per_client.values()
+        )
+        lines.append("")
+        lines.append(
+            f"tenants ({len(per_client)} clients over the service's "
+            "life):"
+        )
+        lines.append(
+            f"{'client':<12} {'subs':>7} {'rows':>10} {'fn subs':>8} "
+            f"{'fn items':>9} {'row share':>10}"
+        )
+        for client, c in sorted(
+            per_client.items(),
+            key=lambda kv: -(
+                kv[1].get("rows", 0) + kv[1].get("fn_items", 0)
+            ),
+        ):
+            rows = c.get("rows", 0) + c.get("fn_items", 0)
+            share = rows / total_rows if total_rows else 0.0
+            lines.append(
+                f"{client:<12} {c.get('submissions', 0):>7} "
+                f"{c.get('rows', 0):>10} "
+                f"{c.get('fn_submissions', 0):>8} "
+                f"{c.get('fn_items', 0):>9} {share:>9.1%}"
             )
     by_bucket = summary.get("by_bucket") or {}
     if by_bucket:

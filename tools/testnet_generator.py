@@ -114,10 +114,16 @@ def peer_indices(topology: str, i: int, n: int) -> list[int]:
     raise ValueError(f"unknown topology {topology!r}")
 
 
-def materialize(manifest: dict, base: str, free_ports) -> dict:
+def materialize(
+    manifest: dict, base: str, free_ports, verify_service: str = ""
+) -> dict:
     """Create node homes for the manifest. `free_ports(n)` supplies
-    distinct free localhost ports. Returns
-    {name: {home, rpc_port, p2p_port, perturb, mode}}."""
+    distinct free localhost ports. `verify_service` (a UDS path) stamps
+    `[scheduler] remote_socket` across every home, so the whole
+    generated net submits its verify work to one shared device-owning
+    service process (`python -m tendermint_tpu verify-service --socket
+    <path>`). Returns {name: {home, rpc_port, p2p_port, perturb,
+    mode}}."""
     from tendermint_tpu.config import Config
     from tendermint_tpu.p2p.key import NodeKey
 
@@ -189,6 +195,9 @@ def materialize(manifest: dict, base: str, free_ports) -> dict:
         cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_ports[i]}"
         cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_ports[i]}"
         cfg.p2p.send_rate = spec.get("send_rate", 0)
+        if verify_service:
+            # absolute: every home must resolve the SAME socket
+            cfg.scheduler.remote_socket = os.path.abspath(verify_service)
         peers = peer_indices(manifest["topology"], i, n)
         cfg.p2p.persistent_peers = ",".join(
             f"{ids[j]}@127.0.0.1:{p2p_ports[j]}" for j in peers
@@ -239,6 +248,15 @@ def main(argv) -> int:
         default="equal",
         help="voting-power distribution across the committee",
     )
+    ap.add_argument(
+        "--verify-service",
+        default="",
+        metavar="SOCKET",
+        help="stamp [scheduler] remote_socket = SOCKET across every "
+        "generated home: the whole net verifies through one shared "
+        "verify-service process (python -m tendermint_tpu "
+        "verify-service --socket SOCKET)",
+    )
     args = ap.parse_args(argv[1:])
     manifest = generate_manifest(
         args.seed,
@@ -260,7 +278,12 @@ def main(argv) -> int:
                 s.close()
             return ports
 
-        layout = materialize(manifest, args.outdir, free_ports)
+        layout = materialize(
+            manifest,
+            args.outdir,
+            free_ports,
+            verify_service=args.verify_service,
+        )
         print(json.dumps(layout, indent=2))
     return 0
 
