@@ -222,10 +222,12 @@ class BlockPool:
             second.block if second else None,
         )
 
-    def peek_window(self, max_blocks: int) -> list[tuple[Block, "Commit"]]:
-        """[(block, successor_last_commit)] for consecutive ready blocks
-        from `height` — each block paired with the commit that verifies it
-        (the multi-block batched-verify window, SURVEY.md §3.4). Stops at
+    def peek_window(self, max_blocks: int) -> list[tuple]:
+        """[(block, successor_last_commit, successor_last_qc)] for
+        consecutive ready blocks from `height` — each block paired with
+        the commit that verifies it (the multi-block batched-verify
+        window, SURVEY.md §3.4) and, on QC-capable chains, the
+        successor's QuorumCertificate (None on legacy blocks). Stops at
         the first gap or successor without a last commit."""
         out = []
         h = self.height
@@ -236,7 +238,11 @@ class BlockPool:
                 break
             if nxt.block.last_commit is None:
                 break  # undecodable/hostile successor; per-block path rejects
-            out.append((r.block, nxt.block.last_commit))
+            out.append((
+                r.block,
+                nxt.block.last_commit,
+                getattr(nxt.block, "last_qc", None),
+            ))
             h += 1
         return out
 

@@ -59,9 +59,18 @@ class BlocksyncReactor(Reactor):
         on_upgrade: Optional[Callable] = None,
         logger: Optional[Logger] = None,
         active: bool = True,
+        qc_enabled: bool = False,
     ):
         super().__init__("blocksync")
         self.active = active
+        # QC plane ([consensus] quorum_certificates): when on and the
+        # chain carries QuorumCertificates, catchup verifies ONE
+        # aggregate pairing per block (a whole window as one
+        # random-linear-combination round) instead of N ed25519 sigs —
+        # blocks without a QC (legacy proposers in a mixed net) fall
+        # back to the batched commit path transparently
+        self.qc_enabled = qc_enabled
+        self.qc_verified_blocks = 0
         self.state = state
         self.executor = executor
         self.block_store = block_store
@@ -261,11 +270,13 @@ class BlocksyncReactor(Reactor):
                 base_hash = base_vals.hash()
                 prepared = []
                 entries = []
-                for first, commit in window:
+                qc_entries = []
+                for first, commit, qc in window:
                     parts = first.make_part_set()
                     fid = BlockID(first.hash(), parts.header)
                     prepared.append((first, fid, parts, commit))
                     entries.append((fid, first.header.height, commit))
+                    qc_entries.append((fid, first.header.height, qc))
                 # device call off-loop: gossip/status handling stays live
                 # while XLA runs (and while any table build holds the
                 # big-tier lock). The classed dispatch routes the batch
@@ -274,14 +285,45 @@ class BlocksyncReactor(Reactor):
                 # coalesces with light/evidence work into shared rounds)
                 from ..parallel.scheduler import default_dispatch
 
-                verdicts = await asyncio.get_running_loop().run_in_executor(
-                    None,
-                    lambda: base_vals.verify_commits_light(
-                        self.state.chain_id,
-                        entries,
-                        verifier=default_dispatch("blocksync"),
-                    ),
+                use_qc = (
+                    self.qc_enabled
+                    and base_vals.qc_capable()
+                    and all(qc is not None for _, _, qc in qc_entries)
                 )
+                verdicts = None
+                if use_qc:
+                    # one qc_verify engine round for the whole window:
+                    # a single RLC multi-pairing — verify cost flat in
+                    # committee size (the QC plane's reason to exist)
+                    verdicts = await (
+                        asyncio.get_running_loop().run_in_executor(
+                            None,
+                            lambda: base_vals.verify_commits_qc(
+                                self.state.chain_id, qc_entries
+                            ),
+                        )
+                    )
+                    if all(verdicts):
+                        self.qc_verified_blocks += len(verdicts)
+                    else:
+                        # a hash-valid block with a bad aggregate: the
+                        # full commit is authoritative (a mixed-mode
+                        # committee may not have crypto-checked the
+                        # proposer's QC) — re-judge the window on the
+                        # N-sig path instead of stalling/punishing on
+                        # the compressed proof
+                        verdicts = None
+                if verdicts is None:
+                    verdicts = await (
+                        asyncio.get_running_loop().run_in_executor(
+                            None,
+                            lambda: base_vals.verify_commits_light(
+                                self.state.chain_id,
+                                entries,
+                                verifier=default_dispatch("blocksync"),
+                            ),
+                        )
+                    )
                 n_ok = 0
                 for v in verdicts:
                     if not v:
@@ -343,16 +385,36 @@ class BlocksyncReactor(Reactor):
                 vals = self.state.validators
                 from ..parallel.scheduler import default_dispatch
 
-                await asyncio.get_running_loop().run_in_executor(
-                    None,
-                    lambda: vals.verify_commit_light(
-                        self.state.chain_id,
-                        first_id,
-                        first.header.height,
-                        second.last_commit,
-                        verifier=default_dispatch("blocksync"),
-                    ),
-                )
+                second_qc = getattr(second, "last_qc", None)
+                qc_ok = False
+                if (
+                    self.qc_enabled
+                    and second_qc is not None
+                    and vals.qc_capable()
+                ):
+                    ok = await asyncio.get_running_loop().run_in_executor(
+                        None,
+                        lambda: vals.verify_commits_qc(
+                            self.state.chain_id,
+                            [(first_id, first.header.height, second_qc)],
+                        ),
+                    )
+                    qc_ok = bool(ok and ok[0])
+                    if qc_ok:
+                        self.qc_verified_blocks += 1
+                if not qc_ok:
+                    # no QC / bad aggregate: the full commit decides
+                    # (the sig path raises into the redo handler below)
+                    await asyncio.get_running_loop().run_in_executor(
+                        None,
+                        lambda: vals.verify_commit_light(
+                            self.state.chain_id,
+                            first_id,
+                            first.header.height,
+                            second.last_commit,
+                            verifier=default_dispatch("blocksync"),
+                        ),
+                    )
                 bls_datas = self._check_batch_data(
                     first, second.last_commit
                 )

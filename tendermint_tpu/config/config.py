@@ -166,6 +166,15 @@ class ConsensusTimeoutsConfig:
     adaptive_min_samples: int = 8
     adaptive_backoff_step: float = 0.5
     adaptive_recover_step: float = 0.1
+    # --- quorum certificates (types/quorum_cert.py) -----------------------
+    # one BLS aggregate per commit instead of N ed25519 sigs for every
+    # downstream consumer: precommits dual-sign the canonical QC
+    # message, proposers carry the aggregated certificate next to the
+    # full commit, and blocksync/light/replay verify ONE pairing.
+    # Requires BLS keys registered for every genesis validator
+    # (bls_pub_key); legacy peers interoperate — they ignore the QC and
+    # keep verifying the full commit.
+    quorum_certificates: bool = False
     # --- committee-scale vote gossip (consensus/reactor.py) ---------------
     # ship all votes a peer is missing per gossip tick in bounded
     # VoteBatchMessage chunks (peers negotiate via the advertised
@@ -204,6 +213,7 @@ class ConsensusTimeoutsConfig:
         "adaptive_min_samples",
         "adaptive_backoff_step",
         "adaptive_recover_step",
+        "quorum_certificates",
     )
 
     def validate_basic(self) -> None:

@@ -57,12 +57,20 @@ class BLSBatcher(MicroBatcher):
         priority — BLS rounds then serialize with ed25519 device rounds
         instead of contending for the backend), else verify directly.
         Runs in an executor thread, so the blocking bridge is safe."""
+        from ..parallel.engines import _bls_agg_rows
         from ..parallel.scheduler import default_scheduler
 
         sched = default_scheduler()
         if sched is not None:
+            # labeled bls_agg with the true internal bucket exposed:
+            # items share the (pk, msg, sig) wire shape, so the engine
+            # table's grouping math prices this closure's round too
+            def run(items):
+                return self._verify_groups(items)
+
+            run.internal_rows = _bls_agg_rows
             return sched.submit_fn_sync(
-                batch, self._verify_groups, "consensus"
+                batch, run, "consensus", engine="bls_agg"
             )
         return self._verify_groups(batch)
 

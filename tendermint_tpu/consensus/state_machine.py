@@ -103,6 +103,12 @@ class ConsensusConfig:
     adaptive_min_samples: int = 8
     adaptive_backoff_step: float = 0.5
     adaptive_recover_step: float = 0.1
+    # --- quorum certificates (types/quorum_cert.py) -----------------------
+    # BLS dual-sign every non-nil precommit over the canonical QC
+    # message, aggregate at +2/3 into one certificate carried next to
+    # the full commit, and verify LastCommits via ONE pairing check.
+    # Requires a qc-capable validator set (every member has a BLS key).
+    quorum_certificates: bool = False
 
     def propose(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_
@@ -715,6 +721,29 @@ class ConsensusState:
             block_data,
             block_time,
         )
+        # QC plane: compress last_commit into a QuorumCertificate and
+        # carry it next to the full commit — assembled on demand from
+        # the retained CommitSigs (one aggregate + one verify per
+        # height, on the proposer only, OFF the event loop: the
+        # pairing check is milliseconds the vote/timeout plane must
+        # not stall on). None (a legacy-signed commit, sub-quorum QC
+        # signatures) just ships the full commit alone.
+        if (
+            self.config.quorum_certificates
+            and last_commit is not None
+            and self.state.last_validators.qc_capable()
+        ):
+            from ..types.quorum_cert import assemble_qc
+
+            block.last_qc = await (
+                asyncio.get_running_loop().run_in_executor(
+                    None,
+                    assemble_qc,
+                    self.state.chain_id,
+                    last_commit,
+                    self.state.last_validators,
+                )
+            )
         # decideBatchPoint (reference :1318-1362): seal when the L2 says
         # size is exceeded OR the on-chain Batch params' blocks_interval /
         # timeout elapsed since the batch start (which survives restarts
@@ -1732,6 +1761,21 @@ class ConsensusState:
             batch_hash = self._batch_hash_for_block(block_hash)
             if batch_hash:
                 vote.bls_signature = self.bls_signer(batch_hash)
+            # QC plane: dual-sign EVERY non-nil precommit over the
+            # canonical QC message (same BLS key, distinct domain) —
+            # the contribution a +2/3 commit aggregates into one
+            # QuorumCertificate
+            if self.config.quorum_certificates:
+                from ..types.quorum_cert import qc_sign_bytes
+
+                vote.qc_signature = self.bls_signer(
+                    qc_sign_bytes(
+                        self.state.chain_id,
+                        rs.height,
+                        rs.round,
+                        vote.block_id,
+                    )
+                )
         try:
             res = self.priv_validator.sign_vote(self.state.chain_id, vote)
             if asyncio.iscoroutine(res):

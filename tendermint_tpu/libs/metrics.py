@@ -726,8 +726,17 @@ class SchedulerMetrics:
         self.fill_ratio = reg.gauge(
             "tm_scheduler_fill_ratio",
             "rows-requested / rows-dispatched of the most recent round "
-            "that carried this class (1.0 = no padding waste)",
+            "that carried this class (1.0 = no padding waste); sig-plane "
+            "rounds only — fn engines report tm_scheduler_fn_fill_ratio",
             ("klass",),
+            raw=True,
+        )
+        self.fn_fill_ratio = reg.gauge(
+            "tm_scheduler_fn_fill_ratio",
+            "items / true internal bucket of the most recent fn-lane "
+            "round per engine (fn engines pad internally; kept off "
+            "tm_scheduler_fill_ratio so the two planes never blend)",
+            ("engine",),
             raw=True,
         )
         self.padding_rows = reg.counter(

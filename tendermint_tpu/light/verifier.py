@@ -26,6 +26,20 @@ def _light_dispatch(verifier):
     return default_dispatch("light")
 
 
+def _qc_usable(lb: LightBlock) -> bool:
+    """A light block proves itself by QC when it carries one and its
+    (hash-pinned) validator set carries the BLS keys — the one-pairing
+    path, flat in committee size. Full-commit blocks (or blocks whose
+    set predates the QC plane) take the N-row batch path."""
+    return lb.qc is not None and lb.validators.qc_capable()
+
+
+def _light_qc_engine():
+    from ..types.quorum_cert import qc_dispatch
+
+    return qc_dispatch("light")
+
+
 class VerificationError(Exception):
     pass
 
@@ -93,7 +107,33 @@ def verify_non_adjacent(
         )
     _common_checks(trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns)
     untrusted.validate_basic(trusted.header.chain_id)
+    if _qc_usable(untrusted):
+        # ONE aggregate check proves BOTH halves of skipping
+        # verification: _qc_item tallies >2/3 of the NEW set's power
+        # in the signer bitset (the _verify_commit_full_power half)
+        # before the pairing check, and the address-overlap tally
+        # proves the >1/3 trusted half by set algebra — so the full-
+        # power pass below is skipped, never paid twice.
+        try:
+            trusted.validators.verify_commit_qc_trusting(
+                trusted.header.chain_id,
+                untrusted.qc,
+                untrusted.validators,
+                trust_numerator,
+                trust_denominator,
+                engine=_light_qc_engine(),
+            )
+        except ValueError as e:
+            # only a thin trusted OVERLAP means "bisect" — a bad
+            # aggregate / sub-quorum certificate is a verification
+            # failure, not a too-far-ahead signal
+            if "trusted voting power" in str(e):
+                raise ErrNewHeaderTooFarAhead(str(e)) from e
+            raise VerificationError(f"invalid commit: {e}") from e
+        return
     try:
+        if untrusted.commit is None:
+            raise ValueError("no commit and no usable qc")
         trusted.validators.verify_commit_light_trusting(
             trusted.header.chain_id,
             untrusted.commit,
@@ -108,6 +148,19 @@ def verify_non_adjacent(
 
 def _verify_commit_full_power(lb: LightBlock, verifier=None) -> None:
     try:
+        if _qc_usable(lb):
+            lb.validators.verify_commit_qc(
+                lb.header.chain_id,
+                lb.qc.block_id,
+                lb.height,
+                lb.qc,
+                engine=_light_qc_engine(),
+            )
+            if lb.qc.block_id.hash != lb.header.hash():
+                raise ValueError("qc is not for this header")
+            return
+        if lb.commit is None:
+            raise ValueError("no commit and no usable qc")
         lb.validators.verify_commit_light(
             lb.header.chain_id,
             BlockID(lb.header.hash(), lb.commit.block_id.part_set_header),

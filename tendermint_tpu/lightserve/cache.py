@@ -76,6 +76,19 @@ class LightBlockCache:
 
     # --- the one entry point ------------------------------------------------
 
+    def get_compressed(self, height: int = 0) -> Optional[LightBlock]:
+        """The QC-compressed proof for `height`: header + validator set
+        + QuorumCertificate, NO CommitSigs — the N-CommitSig payload a
+        million-client read plane should not be shipping per request
+        drops to ~100 bytes + signer bitset. Falls back to the full
+        proof on heights without a canonical QC (legacy blocks, the
+        tip). Shares the full-proof cache entry: the compressed view is
+        a cheap per-request reshape, never a second assembly."""
+        lb = self.get(height)
+        if lb is None or lb.qc is None:
+            return lb
+        return LightBlock(lb.header, None, lb.validators, qc=lb.qc)
+
     def get(self, height: int = 0) -> Optional[LightBlock]:
         """The LightBlock for `height` (0 = the store head), cached when
         its canonical commit is durable, assembled fresh otherwise."""
@@ -138,11 +151,17 @@ class LightBlockCache:
         vals = self._state_store.load_validators(h)
         if vals is None:
             return None
+        # canonical QC (block h+1's last_qc) rides the same entry; None
+        # on legacy heights and at the tip
+        qc = None
+        load_qc = getattr(self._block_store, "load_block_qc", None)
+        if load_qc is not None:
+            qc = load_qc(h)
         self.assembled += 1
         self.metrics.cache_assemble_seconds.observe(
             time.perf_counter() - t0
         )
-        return LightBlock(meta.header, commit, vals)
+        return LightBlock(meta.header, commit, vals, qc=qc)
 
     # --- introspection ------------------------------------------------------
 

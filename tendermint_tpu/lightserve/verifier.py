@@ -61,8 +61,19 @@ def _commit_digest(commit) -> bytes:
     commits for the same header but different signature sets verify
     differently, so the key must distinguish them. Commit.hash() is the
     memoized merkle root over the signature encodings — on the shared
-    cache-served objects the per-request cost is an attribute read."""
-    return commit.hash()
+    cache-served objects the per-request cost is an attribute read.
+    QC-compressed proofs (commit=None) digest empty here; their proof
+    content is keyed by _qc_digest."""
+    return commit.hash() if commit is not None else b""
+
+
+def _qc_digest(lb) -> bytes:
+    """The QuorumCertificate's content digest for the verdict-cache
+    key — a qc-compressed proof verifies through a different input set
+    (signer bitset + aggregate) than the same header's full commit, so
+    the two must not share a verdict entry."""
+    qc = getattr(lb, "qc", None)
+    return qc.encode() if qc is not None else b""
 
 
 class ServeVerifier:
@@ -144,6 +155,7 @@ class ServeVerifier:
             untrusted.header.hash(),
             untrusted.validators.hash(),
             _commit_digest(untrusted.commit),
+            _qc_digest(untrusted),
             int(trusting_period_ns),
         )
         await self._run(
@@ -170,6 +182,7 @@ class ServeVerifier:
             lb.header.hash(),
             lb.validators.hash(),
             _commit_digest(lb.commit),
+            _qc_digest(lb),
         )
         await self._run(
             key,

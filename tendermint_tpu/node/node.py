@@ -413,6 +413,7 @@ class Node(Service):
             event_bus=self.event_bus,
             evidence_pool=self.evidence_pool,
             logger=self.logger,
+            qc_enabled=config.consensus.quorum_certificates,
         )
 
         # --- sequencer components (node.go:1007-1032) ---
@@ -599,6 +600,7 @@ class Node(Service):
             on_upgrade=self._switch_to_sequencer_mode,
             logger=self.logger,
             active=False,  # started explicitly when peers are configured
+            qc_enabled=config.consensus.quorum_certificates,
         )
 
         # --- statesync reactor (node.go:916) ---

@@ -96,6 +96,15 @@ class DispatchLedger:
         self._queue_wait_seconds = 0.0
         self._host_prep_seconds = 0.0
         self._per_class: dict[str, _ClassAccount] = {}
+        # engine -> {rounds, submissions, rows_requested,
+        # rows_dispatched, device_seconds}: the honest
+        # requests-per-dispatch axis. The GLOBAL rpd is structurally
+        # diluted by one-submission fn rounds (every bls_agg round is
+        # exactly one submission by construction), so the coalescing
+        # claim reads per-engine — "sig" is the coalesced ed25519
+        # plane, named engines (bls_agg, qc_verify, secp_recover) and
+        # anonymous "fn" closures each get their own row
+        self._per_engine: dict[str, dict] = {}
         # bucket -> {rounds, rows_requested, submissions}: the
         # amortization curve's x-axis (bounded by the ladder + its
         # multiples, not by traffic)
@@ -129,7 +138,10 @@ class DispatchLedger:
         enqueue->dispatch wait."""
         requested = int(requested)
         dispatched = max(int(dispatched), requested)
-        fn = engine == "fn"
+        # every engine other than the coalesced ed25519 plane is an
+        # fn-lane round (anonymous closures book as "fn"; wire engines
+        # carry their name) — its rows/fill live on the fn axis
+        fn = engine != "sig"
         fill = (requested / dispatched) if dispatched else 0.0
         # normalize the optional per-class maps once: a single-class
         # round's submissions/wait belong to that class even when the
@@ -176,6 +188,17 @@ class DispatchLedger:
             else:
                 self._rows_requested += requested
                 self._rows_dispatched += dispatched
+            eng = self._per_engine.get(engine)
+            if eng is None:
+                eng = self._per_engine[engine] = {
+                    "rounds": 0, "submissions": 0, "rows_requested": 0,
+                    "rows_dispatched": 0, "device_seconds": 0.0,
+                }
+            eng["rounds"] += 1
+            eng["submissions"] += int(submissions)
+            eng["rows_requested"] += requested
+            eng["rows_dispatched"] += dispatched
+            eng["device_seconds"] += device_s
             if devices > 1:
                 self._sharded_rounds += 1
             self._submissions += int(submissions)
@@ -253,7 +276,11 @@ class DispatchLedger:
         base = since or {}
         since_seq = int(base.get("seq", 0))
         span = self.entries(since_seq=since_seq)
-        sig_fills = sorted(e["fill"] for e in span if e["engine"] != "fn")
+        # fill percentiles are a SIG-plane distribution: fn engines'
+        # internal buckets are honest now, but blending a 0.59-full
+        # bls_agg aggregate with a 0.95-full ed25519 bucket prices
+        # nothing — each plane reads its own axis (per_engine below)
+        sig_fills = sorted(e["fill"] for e in span if e["engine"] == "sig")
         rounds = now["rounds"] - base.get("rounds", 0)
         fn_rounds = now["fn_rounds"] - base.get("fn_rounds", 0)
         requested = now["rows_requested"] - base.get("rows_requested", 0)
@@ -261,10 +288,14 @@ class DispatchLedger:
         submissions = now["submissions"] - base.get("submissions", 0)
         device_s = now["device_seconds"] - base.get("device_seconds", 0.0)
         per_class: dict[str, dict] = {}
+        per_engine: dict[str, dict] = {}
         if since is None:
             with self._lock:
                 per_class = {
                     k: v.to_json() for k, v in self._per_class.items()
+                }
+                per_engine = {
+                    k: dict(v) for k, v in self._per_engine.items()
                 }
         else:
             # span view: rebuild per-class from retained entries (exact
@@ -280,13 +311,32 @@ class DispatchLedger:
                     acct.submissions += e["subs"].get(klass, 0)
                     acct.queue_wait_seconds += e["wait"].get(klass, 0.0)
             per_class = {k: v.to_json() for k, v in accts.items()}
+            for e in span:
+                eng = per_engine.setdefault(
+                    e["engine"],
+                    {"rounds": 0, "submissions": 0, "rows_requested": 0,
+                     "rows_dispatched": 0, "device_seconds": 0.0},
+                )
+                eng["rounds"] += 1
+                eng["submissions"] += e["submissions"]
+                eng["rows_requested"] += e["requested"]
+                eng["rows_dispatched"] += e["dispatched"]
+                eng["device_seconds"] += e["device_s"]
         for entry in per_class.values():
             entry["device_share"] = round(
                 entry["device_seconds"] / device_s, 4
             ) if device_s > 0 else 0.0
+        for eng in per_engine.values():
+            eng["device_seconds"] = round(eng["device_seconds"], 6)
+            eng["fill_ratio"] = round(
+                eng["rows_requested"] / eng["rows_dispatched"], 4
+            ) if eng["rows_dispatched"] else 0.0
+            eng["requests_per_dispatch"] = round(
+                eng["submissions"] / eng["rounds"], 3
+            ) if eng["rounds"] else 0.0
         by_bucket: dict[int, dict] = {}
         for e in span:
-            if e["engine"] == "fn":
+            if e["engine"] != "sig":
                 continue
             b = by_bucket.setdefault(
                 e["dispatched"],
@@ -311,6 +361,7 @@ class DispatchLedger:
             "fill_ratio_p95": round(pct(sig_fills, 0.95), 4),
             "requests_per_dispatch": round(submissions / rounds, 3)
             if rounds else 0.0,
+            "per_engine": dict(sorted(per_engine.items())),
             "device_seconds": round(device_s, 6),
             "queue_wait_seconds": round(
                 now["queue_wait_seconds"]

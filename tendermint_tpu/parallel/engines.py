@@ -1,0 +1,128 @@
+"""Named fn-lane engines — the wire-able private-engine table.
+
+The scheduler's fn lane originally carried only closures, which cannot
+cross a process boundary; PR 13 introduced named engines on the
+verify-service wire (`bls_agg`, `secp_recover`). This module is the ONE
+table both runtimes resolve from — `VerifyScheduler.submit_wire_fn(_sync)`
+(in-proc) and `VerifyServiceServer` (cross-process) — so an engine added
+here (like the QC plane's `qc_verify`) coalesces identically in both
+topologies.
+
+Every engine takes a list of wire-able items (tuples of bytes) and
+returns aligned verdicts; unparseable inputs are False/None verdicts,
+never connection errors. Engines additionally expose `internal_rows`
+(items -> padded row count): the fn lane pads INTERNALLY (a 150-signer
+bls_agg group runs as one 256-bucket aggregate round), and the ledger
+books that true bucket so fn fill efficiency is honest instead of the
+former dispatched==requested fiction — and stays on its own per-engine
+axis, never blended into the sig plane's fill distribution.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..crypto.shape_registry import default_shape_registry
+
+
+class WireError(Exception):
+    """Malformed engine item (shared with the verify-service frame
+    decoding contract — re-exported there)."""
+
+
+def _engine_bls_agg(items: list[tuple]) -> list:
+    """(bls_pubkey_bytes, message, sig_bytes) triples -> per-item bool
+    verdicts. Groups by message like BLSBatcher._verify_groups (a
+    consensus round's dual-signs share one batch hash) and runs the
+    real random-linear-combination aggregate — 2 pairings per all-valid
+    group. Unparseable keys/sigs are False, never a connection error."""
+    from ..crypto import bls_signatures as bls
+
+    reg = default_shape_registry()
+    groups: dict[bytes, list[int]] = {}
+    for i, parts in enumerate(items):
+        if len(parts) != 3:
+            raise WireError("bls_agg item needs (pubkey, msg, sig)")
+        groups.setdefault(parts[1], []).append(i)
+    verdicts: list = [False] * len(items)
+    for msg, idxs in groups.items():
+        reg.record_dispatch("bls_agg", reg.bucket_for(len(idxs)))
+        pubs, sigs, ok_idx = [], [], []
+        for i in idxs:
+            try:
+                pubs.append(
+                    bls.public_key_from_bytes(
+                        items[i][0], trusted_source=True
+                    )
+                )
+                sigs.append(bls.g1_from_bytes(items[i][2]))
+                ok_idx.append(i)
+            except bls.BLSError:
+                pass  # verdict stays False
+        if not ok_idx:
+            continue
+        for i, v in zip(
+            ok_idx, bls.verify_batch_same_message(msg, pubs, sigs)
+        ):
+            verdicts[i] = bool(v)
+    return verdicts
+
+
+def _bls_agg_rows(items: list[tuple]) -> int:
+    """True internal rows of a bls_agg round: each same-message group
+    pads to its ladder bucket (the 256 rung is the 100-200 signer
+    home)."""
+    reg = default_shape_registry()
+    groups: dict[bytes, int] = {}
+    for parts in items:
+        if len(parts) == 3:
+            groups[parts[1]] = groups.get(parts[1], 0) + 1
+    return sum(reg.bucket_for(n) for n in groups.values())
+
+
+_engine_bls_agg.internal_rows = _bls_agg_rows
+
+
+def _engine_secp_recover(items: list[tuple]) -> list:
+    """(hash32, sig65) pairs -> recovered eth address bytes (empty on
+    failure). The sequencer-set membership check stays client-side —
+    the allowed set is the client's config, not the service's."""
+    from ..crypto import secp256k1
+
+    out: list = []
+    for parts in items:
+        if len(parts) != 2:
+            raise WireError("secp_recover item needs (hash, sig)")
+        h, sig = parts
+        try:
+            addr = secp256k1.eth_recover_address(h, sig) if sig else None
+        except Exception:
+            addr = None
+        out.append(addr or b"")
+    return out
+
+
+def _engine_qc_verify(items: list[tuple]) -> list:
+    """(message, agg_sig_96, signer_pubkeys_concat) -> per-item bool
+    verdicts: one 2-pairing aggregate check per QC, a whole round as a
+    single random-linear-combination multi-pairing (crypto/
+    bls_signatures.verify_qc_items). The flat-in-committee-size commit
+    verify the QC plane exists for."""
+    from ..crypto.bls_signatures import BLSError, verify_qc_items
+
+    try:
+        return verify_qc_items(items)
+    except BLSError as e:
+        raise WireError(str(e)) from None
+
+
+# qc items are not bucket-padded — each is one aggregate check whose
+# pairing cost is independent of signer count
+_engine_qc_verify.internal_rows = len
+
+
+BUILTIN_ENGINES: dict[str, Callable[[list], list]] = {
+    "bls_agg": _engine_bls_agg,
+    "secp_recover": _engine_secp_recover,
+    "qc_verify": _engine_qc_verify,
+}

@@ -103,15 +103,21 @@ class _Submission:
     round completes."""
 
     __slots__ = (
-        "items", "klass", "n", "fn", "verdicts", "remaining", "offset",
-        "future", "t_enq", "failed",
+        "items", "klass", "n", "fn", "engine", "verdicts", "remaining",
+        "offset", "future", "t_enq", "failed",
     )
 
-    def __init__(self, items, klass, future, fn=None):
+    def __init__(self, items, klass, future, fn=None, engine="fn"):
         self.items = items
         self.klass = klass
         self.n = len(items)
         self.fn = fn  # non-None => private-engine lane (e.g. BLS groups)
+        # accounting label for fn-lane rounds: "fn" for anonymous
+        # closures, the wire-engine name (bls_agg / qc_verify /
+        # secp_recover) when known — the ledger breaks rpd/fill out
+        # per engine so one-submission fn rounds stop diluting the sig
+        # plane's coalescing numbers
+        self.engine = engine
         self.verdicts = (
             None if fn is not None else np.zeros(self.n, dtype=bool)
         )
@@ -268,12 +274,13 @@ class VerifyScheduler:
 
     async def submit_fn(
         self, items: list, fn: Callable[[list], list],
-        klass: str = "consensus",
+        klass: str = "consensus", engine: str = "fn",
     ):
         """Private-engine lane: `fn(items)` runs as its own round on the
         shared dispatch thread, under the same priority ordering — the
         BLS batch-point batcher rides this so pairing checks and ed25519
-        rounds serialize instead of contending for the device."""
+        rounds serialize instead of contending for the device. `engine`
+        is the accounting label (wire-engine name when known)."""
         items = list(items)
         if not items:
             return []
@@ -281,13 +288,54 @@ class VerifyScheduler:
             return await asyncio.get_running_loop().run_in_executor(
                 None, fn, items
             )
-        return await self._enqueue(items, klass, fn=fn)
+        return await self._enqueue(items, klass, fn=fn, engine=engine)
 
-    async def _enqueue(self, items, klass, fn):
+    async def submit_wire_fn(
+        self,
+        engine: str,
+        items: list,
+        klass: str = "consensus",
+        fallback: Optional[Callable[[], list]] = None,
+    ):
+        """Named-engine lane — the in-proc half of the wire-engine
+        surface (RemoteVerifyScheduler ships the same call over the
+        UDS): resolve `engine` from the shared table
+        (parallel/engines.BUILTIN_ENGINES) and run it as a labeled fn
+        round. Unknown engines run the caller's `fallback` instead."""
+        from .engines import BUILTIN_ENGINES
+
+        fn = BUILTIN_ENGINES.get(engine)
+        if fn is None:
+            fb = fallback or (lambda: [None] * len(items))
+            return await asyncio.get_running_loop().run_in_executor(
+                None, fb
+            )
+        return await self.submit_fn(items, fn, klass, engine=engine)
+
+    def submit_wire_fn_sync(
+        self,
+        engine: str,
+        items: list,
+        klass: str = "consensus",
+        fallback: Optional[Callable[[], list]] = None,
+    ):
+        """Blocking named-engine submit for worker threads — same
+        degradation rules as submit_fn_sync, with unknown engines
+        running `fallback` on the calling thread."""
+        from .engines import BUILTIN_ENGINES
+
+        items = list(items)
+        fn = BUILTIN_ENGINES.get(engine)
+        if fn is None:
+            fb = fallback or (lambda: [None] * len(items))
+            return fb()
+        return self.submit_fn_sync(items, fn, klass, engine=engine)
+
+    async def _enqueue(self, items, klass, fn, engine="fn"):
         if klass not in self._queues:
             klass = "blocksync"  # unknown classes ride the bulk lane
         fut = self._loop.create_future()
-        sub = _Submission(items, klass, fut, fn=fn)
+        sub = _Submission(items, klass, fut, fn=fn, engine=engine)
         self._queues[klass].append(sub)
         self._wakeup.set()
         # gauge scope = submitted until verdicts resolve (in flight)
@@ -305,12 +353,12 @@ class VerifyScheduler:
             return _NOT_RUNNING
         return await self._enqueue(list(items), klass, fn=None)
 
-    async def _submit_fn_for_thread(self, items, fn, klass):
+    async def _submit_fn_for_thread(self, items, fn, klass, engine="fn"):
         if not items:
             return []
         if not self.running:
             return _NOT_RUNNING
-        return await self._enqueue(list(items), klass, fn=fn)
+        return await self._enqueue(list(items), klass, fn=fn, engine=engine)
 
     def submit_sync(
         self, items: list[SigItem], klass: str = "consensus"
@@ -340,7 +388,7 @@ class VerifyScheduler:
 
     def submit_fn_sync(
         self, items: list, fn: Callable[[list], list],
-        klass: str = "consensus",
+        klass: str = "consensus", engine: str = "fn",
     ):
         items = list(items)
         loop = self._loop
@@ -348,7 +396,7 @@ class VerifyScheduler:
             return fn(items)
         try:
             fut = asyncio.run_coroutine_threadsafe(
-                self._submit_fn_for_thread(items, fn, klass), loop
+                self._submit_fn_for_thread(items, fn, klass, engine), loop
             )
             res = fut.result()
             if res is _NOT_RUNNING:
@@ -517,25 +565,42 @@ class VerifyScheduler:
             if not sub.future.done():
                 sub.future.set_result(verdicts)
             self.dispatch_log.append(
-                {"n": sub.n, "subs": 1, "classes": [sub.klass], "fn": True}
+                {"n": sub.n, "subs": 1, "classes": [sub.klass],
+                 "fn": True, "engine": sub.engine}
             )
             wait = t0 - sub.t_enq
             self.metrics.device_seconds.inc(dur, klass=sub.klass)
+            # fn engines pad INTERNALLY (a 150-signer bls_agg group runs
+            # one 256-bucket aggregate round); engines that expose their
+            # true bucket via `internal_rows` book it honestly — on the
+            # fn plane's own per-engine axis, never blended into the sig
+            # plane's fill distribution
+            internal = getattr(sub.fn, "internal_rows", None)
+            try:
+                dispatched = (
+                    max(sub.n, int(internal(sub.items)))
+                    if callable(internal) else sub.n
+                )
+            except Exception:
+                dispatched = sub.n
+            self.metrics.fn_fill_ratio.set(
+                round(sub.n / dispatched, 4) if dispatched else 0.0,
+                engine=sub.engine,
+            )
             self.ledger.record_round(
                 t0,
                 class_rows={sub.klass: sub.n},
                 requested=sub.n,
-                dispatched=sub.n,  # fn lanes pad internally: no
-                # bucket waste attributable here
+                dispatched=dispatched,
                 submissions=1,
                 queue_wait_s=wait,
                 class_queue_wait={sub.klass: wait},
                 device_s=dur,
-                engine="fn",
+                engine=sub.engine,
             )
             tracer.add_span(
                 "scheduler.device_round", t0, dur,
-                n=sub.n, engine="fn", klass=sub.klass,
+                n=sub.n, engine=sub.engine, klass=sub.klass,
             )
             return
         _, slices, total = round_

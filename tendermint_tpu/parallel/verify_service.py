@@ -114,8 +114,11 @@ _U64 = struct.Struct(">Q")
 _HDR = struct.Struct(">BQ")  # type, request_id
 
 
-class WireError(Exception):
-    """Frame decode violation (cap, truncation, unknown tag)."""
+# Frame decode violations (cap, truncation, unknown tag) share the
+# engine-item violation class — both are protocol errors, and the
+# engines live in parallel/engines.py so the in-proc scheduler resolves
+# the same table
+from .engines import WireError  # noqa: F401  (re-export, wire contract)
 
 
 # --- encoding helpers -------------------------------------------------------
@@ -309,69 +312,15 @@ def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
 
 
 # --- server-side fn engines -------------------------------------------------
+# The table lives in parallel/engines.py (shared with the in-proc
+# scheduler's submit_wire_fn); re-exported names keep existing callers
+# (tools/verify_service_bench.py) working.
 
-
-def _engine_bls_agg(items: list[tuple]) -> list:
-    """(bls_pubkey_bytes, message, sig_bytes) triples -> per-item bool
-    verdicts. Groups by message like BLSBatcher._verify_groups (a
-    consensus round's dual-signs share one batch hash) and runs the
-    real random-linear-combination aggregate — 2 pairings per all-valid
-    group. Unparseable keys/sigs are False, never a connection error."""
-    from ..crypto import bls_signatures as bls
-
-    reg = default_shape_registry()
-    groups: dict[bytes, list[int]] = {}
-    for i, parts in enumerate(items):
-        if len(parts) != 3:
-            raise WireError("bls_agg item needs (pubkey, msg, sig)")
-        groups.setdefault(parts[1], []).append(i)
-    verdicts: list = [False] * len(items)
-    for msg, idxs in groups.items():
-        reg.record_dispatch("bls_agg", reg.bucket_for(len(idxs)))
-        pubs, sigs, ok_idx = [], [], []
-        for i in idxs:
-            try:
-                pubs.append(
-                    bls.public_key_from_bytes(
-                        items[i][0], trusted_source=True
-                    )
-                )
-                sigs.append(bls.g1_from_bytes(items[i][2]))
-                ok_idx.append(i)
-            except bls.BLSError:
-                pass  # verdict stays False
-        if not ok_idx:
-            continue
-        for i, v in zip(
-            ok_idx, bls.verify_batch_same_message(msg, pubs, sigs)
-        ):
-            verdicts[i] = bool(v)
-    return verdicts
-
-
-def _engine_secp_recover(items: list[tuple]) -> list:
-    """(hash32, sig65) pairs -> recovered eth address bytes (empty on
-    failure). The sequencer-set membership check stays client-side —
-    the allowed set is the client's config, not the service's."""
-    from ..crypto import secp256k1
-
-    out: list = []
-    for parts in items:
-        if len(parts) != 2:
-            raise WireError("secp_recover item needs (hash, sig)")
-        h, sig = parts
-        try:
-            addr = secp256k1.eth_recover_address(h, sig) if sig else None
-        except Exception:
-            addr = None
-        out.append(addr or b"")
-    return out
-
-
-BUILTIN_ENGINES: dict[str, Callable[[list], list]] = {
-    "bls_agg": _engine_bls_agg,
-    "secp_recover": _engine_secp_recover,
-}
+from .engines import (  # noqa: E402,F401
+    BUILTIN_ENGINES,
+    _engine_bls_agg,
+    _engine_secp_recover,
+)
 
 
 # --- the server -------------------------------------------------------------
@@ -609,7 +558,9 @@ class VerifyServiceServer:
             )
             return
         try:
-            results = await self.scheduler.submit_fn(items, fn, klass)
+            results = await self.scheduler.submit_fn(
+                items, fn, klass, engine=engine
+            )
         except Exception as e:
             await self._send_guarded(
                 send,
@@ -941,12 +892,13 @@ class RemoteVerifyScheduler:
 
     async def submit_fn(
         self, items: list, fn: Callable[[list], list],
-        klass: str = "consensus",
+        klass: str = "consensus", engine: str = "fn",
     ):
         """Closure lane: a function object cannot cross the process
         boundary, so it runs locally (off-loop) — identical semantics
         to the in-proc scheduler's degraded path. Wire-able engines go
-        through submit_wire_fn instead."""
+        through submit_wire_fn instead (`engine` here is only the
+        accounting label, accepted for surface parity)."""
         items = list(items)
         if not items:
             return []
@@ -1040,7 +992,7 @@ class RemoteVerifyScheduler:
 
     def submit_fn_sync(
         self, items: list, fn: Callable[[list], list],
-        klass: str = "consensus",
+        klass: str = "consensus", engine: str = "fn",
     ):
         # closures run on the calling worker thread — exactly where the
         # in-proc scheduler's degraded path runs them

@@ -115,6 +115,7 @@ class SignerClient:
         vote.signature = signed.signature
         vote.timestamp_ns = signed.timestamp_ns
         vote.bls_signature = signed.bls_signature
+        vote.qc_signature = signed.qc_signature
 
     async def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
         resp = await self._ep.request(

@@ -488,6 +488,11 @@ class RPCCore:
             "pub_key_type": getattr(v.pub_key, "type_name", "ed25519"),
             "voting_power": v.voting_power,
             "proposer_priority": v.proposer_priority,
+            **(
+                {"bls_pub_key": _hex(v.bls_pub_key)}
+                if v.bls_pub_key
+                else {}
+            ),
         }
 
     def validators(self, height=None, page=None, per_page=None, **_kw) -> dict:
@@ -502,11 +507,12 @@ class RPCCore:
 
     # --- light-client serving plane (tendermint_tpu/lightserve) -------------
 
-    def _lightserve_block(self, height):
+    def _lightserve_block(self, height, compressed=False):
         from .server import RPCError
 
         h = int(height) if height else 0
-        lb = self.node.lightserve.cache.get(h)
+        cache = self.node.lightserve.cache
+        lb = cache.get_compressed(h) if compressed else cache.get(h)
         if lb is None:
             raise RPCError(
                 -32000, f"no light block at height {h or 'latest'}"
@@ -516,17 +522,35 @@ class RPCCore:
     def _signed_header_json(self, lb) -> dict:
         return {
             "header": self._header_json(lb.header),
-            "commit": self._commit_json(lb.commit),
+            "commit": (
+                self._commit_json(lb.commit)
+                if lb.commit is not None
+                else None
+            ),
         }
 
-    def light_block(self, height=None, **_kw) -> dict:
+    def light_block(self, height=None, proof=None, **_kw) -> dict:
         """The full proof for one height — signed header + validator set
         assembled once by the LightBlockCache and served to every
-        client (one round trip instead of commit + validators)."""
-        lb = self._lightserve_block(height)
+        client (one round trip instead of commit + validators).
+        `proof="qc"` requests the QC-compressed shape: the N-CommitSig
+        payload is dropped and the QuorumCertificate alone proves the
+        header (capability negotiation at the RPC layer — legacy
+        clients never send the param and keep the full commit; heights
+        without a canonical QC fall back to the full proof)."""
+        if proof not in (None, "", "full", "qc"):
+            from .server import RPCError
+
+            raise RPCError(-32602, f"unknown proof format {proof!r}")
+        lb = self._lightserve_block(height, compressed=proof == "qc")
         return {
             "light_block": {
                 "signed_header": self._signed_header_json(lb),
+                **(
+                    {"qc": self._qc_json(lb.qc)}
+                    if lb.qc is not None
+                    else {}
+                ),
                 # the FULL set, un-paginated: this IS the proof — a
                 # partial set could never re-hash to validators_hash
                 "validator_set": {
@@ -809,9 +833,20 @@ class RPCCore:
                     "timestamp": s.timestamp_ns,
                     "signature": _hex(s.signature),
                     "bls_signature": _hex(s.bls_signature),
+                    "qc_signature": _hex(s.qc_signature),
                 }
                 for s in c.signatures
             ],
+        }
+
+    def _qc_json(self, qc) -> dict:
+        return {
+            "height": qc.height,
+            "round": qc.round,
+            "block_id": self._bid_json(qc.block_id),
+            "signers_size": qc.signers.size,
+            "signers": _hex(qc.signers.to_bytes()),
+            "agg_signature": _hex(qc.agg_signature),
         }
 
     def _block_json(self, b) -> dict:

@@ -101,9 +101,35 @@ def commit_from_json(cm: dict):
                 timestamp_ns=s["timestamp"],
                 signature=bytes.fromhex(s["signature"]),
                 bls_signature=bytes.fromhex(s.get("bls_signature", "")),
+                qc_signature=bytes.fromhex(s.get("qc_signature", "")),
             )
             for s in cm["signatures"]
         ],
+    )
+
+
+def qc_from_json(q: dict):
+    """Parse a QuorumCertificate from its RPC JSON form
+    (rpc/core._qc_json)."""
+    from ..libs.bits import BitArray
+    from ..types.block_id import BlockID
+    from ..types.part_set import PartSetHeader
+    from ..types.quorum_cert import QuorumCertificate
+
+    return QuorumCertificate(
+        height=q["height"],
+        round=q["round"],
+        block_id=BlockID(
+            hash=bytes.fromhex(q["block_id"]["hash"]),
+            part_set_header=PartSetHeader(
+                q["block_id"]["parts"]["total"],
+                bytes.fromhex(q["block_id"]["parts"]["hash"]),
+            ),
+        ),
+        signers=BitArray.from_bytes(
+            int(q["signers_size"]), bytes.fromhex(q["signers"])
+        ),
+        agg_signature=bytes.fromhex(q["agg_signature"]),
     )
 
 
@@ -122,6 +148,7 @@ def validators_from_json(rows: list):
                 ),
                 val["voting_power"],
                 val.get("proposer_priority", 0),
+                bls_pub_key=bytes.fromhex(val.get("bls_pub_key", "")),
             )
             for val in rows
         ]
@@ -221,11 +248,15 @@ class RPCProvider:
                     )
                     self._has_light_block = True
                     lb = res["light_block"]
+                    cm = lb["signed_header"].get("commit")
                     return LightBlock(
                         header_from_json(lb["signed_header"]["header"]),
-                        commit_from_json(lb["signed_header"]["commit"]),
+                        commit_from_json(cm) if cm else None,
                         validators_from_json(
                             lb["validator_set"]["validators"]
+                        ),
+                        qc=(
+                            qc_from_json(lb["qc"]) if lb.get("qc") else None
                         ),
                     )
                 except RPCClientError as e:

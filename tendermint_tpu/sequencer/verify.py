@@ -62,6 +62,7 @@ class SequencerVerifyBatcher(MicroBatcher):
         sched = default_scheduler()
         if sched is not None:
             return sched.submit_fn_sync(
-                blocks, self._check, klass="sequencer"
+                blocks, self._check, klass="sequencer",
+                engine="secp_recover",
             )
         return self._check(blocks)

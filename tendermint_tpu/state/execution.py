@@ -110,6 +110,7 @@ class BlockExecutor:
         event_bus=None,
         evidence_pool=None,
         logger: Optional[Logger] = None,
+        qc_enabled: bool = False,
     ):
         self._state_store = state_store
         self._block_store = block_store
@@ -118,6 +119,11 @@ class BlockExecutor:
         self._event_bus = event_bus
         self._evpool = evidence_pool
         self.logger = logger or nop_logger()
+        # QC plane ([consensus] quorum_certificates): blocks carrying a
+        # QuorumCertificate validate their LastCommit with one aggregate
+        # pairing check — live validation, blocksync revalidation and
+        # WAL-replay apply all funnel through validate_block
+        self.qc_enabled = qc_enabled
 
     # --- proposal ---------------------------------------------------------
 
@@ -166,9 +172,16 @@ class BlockExecutor:
 
     # --- validation -------------------------------------------------------
 
-    def validate_block(self, state: State, block: Block, verifier=None) -> None:
+    def validate_block(
+        self, state: State, block: Block, verifier=None, qc_engine=None
+    ) -> None:
         """Stateful validation incl. evidence (reference ValidateBlock :207)."""
-        state.make_block_validate(block, verifier=verifier)
+        state.make_block_validate(
+            block,
+            verifier=verifier,
+            use_qc=self.qc_enabled,
+            qc_engine=qc_engine,
+        )
         if self._evpool:
             for ev in block.evidence:
                 self._evpool.check_evidence(ev, state)
@@ -187,10 +200,12 @@ class BlockExecutor:
         flood never queues at live-vote priority. Raises exactly what
         validate_block raises."""
         from ..parallel.scheduler import default_dispatch
+        from ..types.quorum_cert import qc_dispatch
 
         verifier = default_dispatch(klass)
+        qc_engine = qc_dispatch(klass) if self.qc_enabled else None
         await asyncio.get_running_loop().run_in_executor(
-            None, self.validate_block, state, block, verifier
+            None, self.validate_block, state, block, verifier, qc_engine
         )
 
     def process_proposal(self, state: State, block: Block) -> bool:

@@ -88,13 +88,19 @@ class State:
             app_hash=genesis.app_hash,
         )
 
-    def make_block_validate(self, block: Block, verifier=None) -> None:
+    def make_block_validate(
+        self, block: Block, verifier=None, use_qc=False, qc_engine=None
+    ) -> None:
         """Stateful block validation (reference state/validation.go
         validateBlock): header fields must chain from this state.
         `verifier` routes the LastCommit signature check (a device
         dispatch) — callers off the event loop pass a scheduler-classed
         adapter so the dispatch coalesces instead of stalling the
-        consensus loop."""
+        consensus loop. With `use_qc` ([consensus] quorum_certificates)
+        a block carrying a QuorumCertificate proves its LastCommit with
+        ONE aggregate pairing check instead of N signature rows — the
+        WAL-replay and blocksync revalidation paths ride this same
+        method, so catchup replay gets the flat-cost check too."""
         block.validate_basic()
         h = block.header
         if h.chain_id != self.chain_id:
@@ -126,13 +132,38 @@ class State:
             # LastCommit must verify against the validators of height-1
             if block.last_commit is None:
                 raise ValueError("nil last commit")
-            self.last_validators.verify_commit_light(
-                self.chain_id,
-                self.last_block_id,
-                self.last_block_height,
-                block.last_commit,
-                verifier=verifier,
-            )
+            if (
+                use_qc
+                and block.last_qc is not None
+                and self.last_validators.qc_capable()
+            ):
+                # the carried commit must still be the SHAPE legacy
+                # consumers will verify — size/height/block_id against
+                # the certified decision (a byzantine proposer pairing
+                # a valid aggregate with a malformed commit would
+                # otherwise split the chain from every full-commit
+                # verifier); the signature ROWS are what the aggregate
+                # replaces (trust model: PERF_ANALYSIS §21)
+                self.last_validators._check_commit_shape(
+                    self.last_block_id,
+                    self.last_block_height,
+                    block.last_commit,
+                )
+                self.last_validators.verify_commit_qc(
+                    self.chain_id,
+                    self.last_block_id,
+                    self.last_block_height,
+                    block.last_qc,
+                    engine=qc_engine,
+                )
+            else:
+                self.last_validators.verify_commit_light(
+                    self.chain_id,
+                    self.last_block_id,
+                    self.last_block_height,
+                    block.last_commit,
+                    verifier=verifier,
+                )
         if h.time_ns <= self.last_block_time_ns and self.last_block_height > 0:
             raise ValueError("block time must be monotonically increasing")
 

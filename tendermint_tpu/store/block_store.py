@@ -33,6 +33,7 @@ _META = b"H:"
 _PART = b"P:"
 _COMMIT = b"C:"
 _SEEN = b"SC:"
+_QC = b"QC:"  # quorum certificate for height (from block height+1)
 _STATE = b"BSS"  # block store state: base/height
 
 
@@ -89,6 +90,10 @@ class BlockStore:
             sets.append(
                 (_h(_COMMIT, height - 1), block.last_commit.encode())
             )
+        if block.last_qc is not None:
+            # the QC plane's canonical record for height-1, next to the
+            # commit it compresses (lightserve serves it as the proof)
+            sets.append((_h(_QC, height - 1), block.last_qc.encode()))
         sets.append((_h(_SEEN, height), seen_commit.encode()))
         return sets
 
@@ -155,6 +160,15 @@ class BlockStore:
         raw = self._db.get(_h(_SEEN, height))
         return Commit.decode(raw) if raw else None
 
+    def load_block_qc(self, height: int):
+        """The canonical QuorumCertificate for `height` (carried by
+        block height+1, like the canonical commit) — None on legacy
+        heights."""
+        from ..types.quorum_cert import QuorumCertificate
+
+        raw = self._db.get(_h(_QC, height))
+        return QuorumCertificate.decode(raw) if raw else None
+
     # --- pruning ----------------------------------------------------------
 
     def prune_blocks(self, retain_height: int) -> int:
@@ -175,6 +189,7 @@ class BlockStore:
                 for i in range(meta.block_id.part_set_header.total):
                     deletes.append(_h(_PART, h, i))
                 deletes.append(_h(_COMMIT, h - 1))
+                deletes.append(_h(_QC, h - 1))
                 deletes.append(_h(_SEEN, h))
                 pruned += 1
             self._base = retain_height
@@ -211,8 +226,9 @@ class BlockStore:
                 for i in range(meta.block_id.part_set_header.total):
                     deletes.append(_h(_PART, h, i))
                 if h - 1 > height:
-                    # keep the canonical commit for the retained head
+                    # keep the canonical commit/QC for the retained head
                     deletes.append(_h(_COMMIT, h - 1))
+                    deletes.append(_h(_QC, h - 1))
                 deletes.append(_h(_SEEN, h))
                 pruned += 1
             self._height = height
@@ -416,6 +432,12 @@ class WriteBehindBlockStore(BlockStore):
         if p is not None:
             return p[2]
         return super().load_seen_commit(height)
+
+    def load_block_qc(self, height: int):
+        p = self._pending_for(height + 1)
+        if p is not None:
+            return p[0].last_qc
+        return super().load_block_qc(height)
 
     # --- pruning ------------------------------------------------------------
 

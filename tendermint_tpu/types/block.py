@@ -25,6 +25,7 @@ from ..libs import protoio as pio
 from . import canonical
 from .block_id import BlockID
 from .part_set import PartSet, PartSetHeader
+from .quorum_cert import QuorumCertificate
 
 BLOCK_PROTOCOL_VERSION = 11  # reference version/version.go block protocol
 
@@ -168,6 +169,10 @@ class CommitSig:
     timestamp_ns: int = 0
     signature: bytes = b""
     bls_signature: bytes = b""  # morph: types/block.go:628
+    # QC plane: the per-vote BLS signature over the canonical QC message
+    # — retained in the commit so a QuorumCertificate can be assembled
+    # on demand from any stored commit (types/quorum_cert.assemble_qc)
+    qc_signature: bytes = b""
 
     @classmethod
     def absent(cls) -> "CommitSig":
@@ -211,6 +216,7 @@ class CommitSig:
                 ),
                 pio.field_bytes(4, self.signature),
                 pio.field_bytes(5, self.bls_signature),
+                pio.field_bytes(6, self.qc_signature),
             ]
         )
 
@@ -223,6 +229,7 @@ class CommitSig:
             timestamp_ns=canonical.decode_timestamp(f.get(3, [b""])[0]),
             signature=f.get(4, [b""])[0],
             bls_signature=f.get(5, [b""])[0],
+            qc_signature=f.get(6, [b""])[0],
         )
 
 
@@ -393,6 +400,13 @@ class Block:
     data: Data = field(default_factory=Data)
     evidence: list = field(default_factory=list)
     last_commit: Optional[Commit] = None
+    # QC plane: the aggregate certificate for last_commit's height,
+    # carried NEXT TO the full commit (never instead of it on the block
+    # wire — legacy consumers keep verifying the N-sig commit; QC
+    # consumers verify one pairing). Not covered by any header hash: a
+    # QC is self-certifying against the validator set the certified
+    # header commits to.
+    last_qc: Optional["QuorumCertificate"] = None
     # memoized (part_size, PartSet): chunking + merkle-proving the
     # encoded block is the priciest host hash on the commit/gossip path
     # and callers re-derive it per call (blocksync window + fallback,
@@ -457,6 +471,12 @@ class Block:
             and self.header.last_commit_hash != self.last_commit.hash()
         ):
             raise ValueError("wrong last commit hash")
+        if self.last_qc is not None:
+            self.last_qc.validate_basic()
+            if self.last_qc.height != self.header.height - 1:
+                raise ValueError("last qc height mismatch")
+            if self.last_qc.block_id != self.header.last_block_id:
+                raise ValueError("last qc block id mismatch")
         if self.header.data_hash != self.data.hash():
             raise ValueError("wrong data hash")
 
@@ -473,6 +493,11 @@ class Block:
                     if self.last_commit is not None
                     else b""
                 ),
+                (
+                    pio.field_message(5, self.last_qc.encode())
+                    if self.last_qc is not None
+                    else b""
+                ),
             ]
         )
 
@@ -484,11 +509,15 @@ class Block:
         last_commit = None
         if 4 in f:
             last_commit = Commit.decode(f[4][0])
+        last_qc = None
+        if 5 in f:
+            last_qc = QuorumCertificate.decode(f[5][0])
         return cls(
             header=Header.decode(f[1][0]),
             data=Data.decode(f.get(2, [b""])[0]),
             evidence=decode_evidence_list(f.get(3, [b""])[0]),
             last_commit=last_commit,
+            last_qc=last_qc,
         )
 
     def __repr__(self) -> str:

@@ -21,11 +21,15 @@ class GenesisValidator:
     pub_key_data: bytes
     power: int
     name: str = ""
+    # QC plane: uncompressed G2 BLS key (192 bytes) — committed into the
+    # validator-set hash so quorum certificates verify against it
+    bls_pub_key: bytes = b""
 
     def to_validator(self) -> Validator:
         return Validator(
             pub_key=pubkey_from_type(self.pub_key_type, self.pub_key_data),
             voting_power=self.power,
+            bls_pub_key=self.bls_pub_key,
         )
 
 
@@ -73,6 +77,11 @@ class GenesisDoc:
                     },
                     "power": str(v.power),
                     "name": v.name,
+                    **(
+                        {"bls_pub_key": v.bls_pub_key.hex()}
+                        if v.bls_pub_key
+                        else {}
+                    ),
                 }
                 for v in self.validators
             ],
@@ -95,6 +104,7 @@ class GenesisDoc:
                     pub_key_data=bytes.fromhex(v["pub_key"]["value"]),
                     power=int(v["power"]),
                     name=v.get("name", ""),
+                    bls_pub_key=bytes.fromhex(v.get("bls_pub_key", "")),
                 )
                 for v in d.get("validators", [])
             ],

@@ -38,6 +38,12 @@ class Validator:
     voting_power: int
     proposer_priority: int = 0
     _address: Optional[bytes] = None
+    # morph QC plane: the validator's BLS12-381 public key (uncompressed
+    # G2 wire, 192 bytes) — committed into the validator-set hash when
+    # present, so a hash-verified set pins the keys a QuorumCertificate
+    # aggregate verifies against. Empty = not QC-capable (legacy sets
+    # hash identically: the field is omitted from the encoding).
+    bls_pub_key: bytes = b""
 
     @property
     def address(self) -> bytes:
@@ -47,7 +53,8 @@ class Validator:
 
     def copy(self) -> "Validator":
         return Validator(
-            self.pub_key, self.voting_power, self.proposer_priority
+            self.pub_key, self.voting_power, self.proposer_priority,
+            bls_pub_key=self.bls_pub_key,
         )
 
     def compare_proposer_priority(self, other: "Validator") -> "Validator":
@@ -66,6 +73,14 @@ class Validator:
             pio.field_bytes(1, pubkey_type_name(self.pub_key).encode())
             + pio.field_bytes(2, self.pub_key.data)
             + pio.field_varint(3, self.voting_power)
+            # field 5 (4 is the set-level priority field, validator_set
+            # encode): only present for QC-capable validators, so legacy
+            # sets keep their exact hash
+            + (
+                pio.field_bytes(5, self.bls_pub_key)
+                if self.bls_pub_key
+                else b""
+            )
         )
 
     def validate_basic(self) -> None:
@@ -75,6 +90,8 @@ class Validator:
             raise ValueError("validator has negative voting power")
         if len(self.address) != 20:
             raise ValueError("wrong validator address size")
+        if self.bls_pub_key and len(self.bls_pub_key) != 192:
+            raise ValueError("wrong bls pubkey size (uncompressed G2)")
 
     def __repr__(self) -> str:
         return (

@@ -36,6 +36,15 @@ PRIORITY_WINDOW_SIZE_FACTOR = 2
 MAX_TOTAL_VOTING_POWER = 2**63 // 8
 
 
+def _default_qc_engine():
+    """Scheduler-routed qc_verify dispatch (blocksync class: the bulk
+    consumers — catchup, light, replay — are the QC verify callers;
+    live consensus paths pass their own engine)."""
+    from .quorum_cert import qc_dispatch
+
+    return qc_dispatch("blocksync")
+
+
 class ValidatorSet:
     def __init__(self, validators: list[Validator]):
         self.validators: list[Validator] = sorted(
@@ -421,6 +430,138 @@ class ValidatorSet:
                 f"insufficient trusted voting power: {tallied} <= {needed}"
             )
 
+    # --- quorum-certificate verification (the QC plane) -------------------
+
+    def qc_capable(self) -> bool:
+        """True when every member carries a BLS key — the precondition
+        for verifying (and assembling) quorum certificates against this
+        set."""
+        return bool(self.validators) and all(
+            v.bls_pub_key for v in self.validators
+        )
+
+    def _qc_item(self, chain_id: str, qc) -> tuple[bytes, bytes, bytes, int]:
+        """(msg, agg_sig, signer-keys-concat, tallied-power) for one QC
+        against this set, after the structural checks. Raises ValueError
+        on shape/quorum problems — the cryptographic verdict is the
+        engine's."""
+        if qc is None:
+            raise ValueError("nil quorum certificate")
+        qc.validate_basic()
+        if qc.signers.size != self.size():
+            raise ValueError(
+                f"qc signer bitset size {qc.signers.size} != "
+                f"valset size {self.size()}"
+            )
+        keys = []
+        tallied = 0
+        for i in qc.signers.ones():
+            val = self.validators[i]
+            if not val.bls_pub_key:
+                raise ValueError(
+                    f"validator {i} has no bls key; set is not qc-capable"
+                )
+            keys.append(val.bls_pub_key)
+            tallied += val.voting_power
+        self._check_maj23(tallied)
+        return (
+            qc.sign_bytes(chain_id),
+            qc.agg_signature,
+            b"".join(keys),
+            tallied,
+        )
+
+    def verify_commit_qc(
+        self,
+        chain_id: str,
+        block_id: BlockID,
+        height: int,
+        qc,
+        engine=None,
+    ) -> None:
+        """The QC replacement for verify_commit_light: >2/3 of this
+        set's power in the signer bitset, then ONE aggregate pairing
+        check over the signers' committed BLS keys — cost flat in
+        committee size. `engine` is an items->verdicts callable (the
+        qc_verify engine); defaults to the scheduler-routed dispatch."""
+        if height != qc.height:
+            raise ValueError("qc height mismatch")
+        if block_id != qc.block_id:
+            raise ValueError("qc block id mismatch")
+        msg, sig, keys, _ = self._qc_item(chain_id, qc)
+        engine = engine or _default_qc_engine()
+        ok = engine([(msg, sig, keys)])
+        if not (len(ok) == 1 and ok[0]):
+            raise ValueError("invalid quorum certificate aggregate")
+
+    def verify_commits_qc(
+        self, chain_id: str, entries: list, engine=None
+    ) -> list[bool]:
+        """Bulk form — entries: [(block_id, height, qc)], one verdict
+        per entry (no exception per entry; callers fall back per
+        height). All well-shaped entries verify as ONE engine
+        submission, i.e. one random-linear-combination multi-pairing
+        round for the whole blocksync window."""
+        items = []
+        spans: list[int] = []  # item index per entry; -1 = malformed
+        for block_id, height, qc in entries:
+            try:
+                if qc is None:
+                    raise ValueError("nil qc")
+                if height != qc.height:
+                    raise ValueError("qc height mismatch")
+                if block_id != qc.block_id:
+                    raise ValueError("qc block id mismatch")
+                msg, sig, keys, _ = self._qc_item(chain_id, qc)
+            except ValueError:
+                spans.append(-1)
+                continue
+            spans.append(len(items))
+            items.append((msg, sig, keys))
+        engine = engine or _default_qc_engine()
+        ok = engine(items) if items else []
+        return [bool(ok[s]) if s >= 0 else False for s in spans]
+
+    def verify_commit_qc_trusting(
+        self,
+        chain_id: str,
+        qc,
+        signer_set: "ValidatorSet",
+        trust_numerator: int = 1,
+        trust_denominator: int = 3,
+        engine=None,
+    ) -> None:
+        """QC form of verify_commit_light_trusting: the aggregate
+        verifies against `signer_set` (the NEW set, whose hash the
+        certified header pins), and this (old, trusted) set need only
+        overlap the signers by > trust-level of its own power — matched
+        by address, exactly like the commit path, but proven by the one
+        aggregate check instead of per-signer verifies."""
+        if trust_denominator == 0:
+            raise ValueError("trust level has zero denominator")
+        msg, sig, keys, _ = signer_set._qc_item(chain_id, qc)
+        engine = engine or _default_qc_engine()
+        ok = engine([(msg, sig, keys)])
+        if not (len(ok) == 1 and ok[0]):
+            raise ValueError("invalid quorum certificate aggregate")
+        tallied = 0
+        seen: set[bytes] = set()
+        for i in qc.signers.ones():
+            addr = signer_set.validators[i].address
+            if addr in seen:
+                continue
+            seen.add(addr)
+            idx, val = self.get_by_address(addr)
+            if idx >= 0 and val is not None:
+                tallied += val.voting_power
+        needed = (
+            self.total_voting_power() * trust_numerator
+        ) // trust_denominator
+        if tallied <= needed:
+            raise ValueError(
+                f"insufficient trusted voting power: {tallied} <= {needed}"
+            )
+
     def _check_commit_shape(
         self, block_id: BlockID, height: int, commit: Commit
     ) -> None:
@@ -467,6 +608,7 @@ class ValidatorSet:
                 pub_key=pk,
                 voting_power=vf.get(3, [0])[0],
                 proposer_priority=vf.get(4, [2**62])[0] - 2**62,
+                bls_pub_key=vf.get(5, [b""])[0],
             )
             vals.append(v)
         vs = cls.__new__(cls)

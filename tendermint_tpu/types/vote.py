@@ -45,6 +45,10 @@ class Vote:
     validator_index: int
     signature: bytes = b""
     bls_signature: bytes = b""  # morph: set on batch-point precommits
+    # QC plane: BLS signature over the canonical QC message
+    # (types/quorum_cert.qc_sign_bytes) — set on every non-nil precommit
+    # when [consensus] quorum_certificates is on, aggregated at +2/3
+    qc_signature: bytes = b""
 
     def sign_bytes(self, chain_id: str) -> bytes:
         return canonical.vote_sign_bytes(chain_id, self)
@@ -92,6 +96,7 @@ class Vote:
                 pio.field_varint(7, self.validator_index + 1),  # 0 is valid
                 pio.field_bytes(8, self.signature),
                 pio.field_bytes(9, self.bls_signature),
+                pio.field_bytes(10, self.qc_signature),
             ]
         )
 
@@ -108,6 +113,7 @@ class Vote:
             validator_index=f.get(7, [1])[0] - 1,
             signature=f.get(8, [b""])[0],
             bls_signature=f.get(9, [b""])[0],
+            qc_signature=f.get(10, [b""])[0],
         )
 
     def __repr__(self) -> str:

@@ -230,6 +230,7 @@ class VoteSet:
                     timestamp_ns=v.timestamp_ns,
                     signature=v.signature,
                     bls_signature=v.bls_signature,
+                    qc_signature=v.qc_signature,
                 )
             )
         return Commit(

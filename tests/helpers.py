@@ -44,12 +44,50 @@ def make_genesis(vs: ValidatorSet, chain_id: str = CHAIN_ID) -> GenesisDoc:
         chain_id=chain_id,
         genesis_time_ns=T0,
         validators=[
-            GenesisValidator("ed25519", v.pub_key.data, v.voting_power)
+            GenesisValidator(
+                "ed25519", v.pub_key.data, v.voting_power,
+                bls_pub_key=v.bls_pub_key,
+            )
             for v in vs.validators
         ],
     )
     doc.validate_and_complete()
     return doc
+
+
+def make_qc_validators(n: int, power: int = 10, seed: bytes = b"val"):
+    """(ValidatorSet, [MockPV], {address: bls_priv}) — a QC-capable
+    committee: every validator carries a BLS key committed into the set
+    hash, and the returned scalar map signs QC contributions.
+    Deterministic in `seed` (BLS scalars derive from it, not from
+    generate_priv_key), so two calls build the same committee."""
+    from tendermint_tpu.crypto import bls_signatures as bls
+    from tendermint_tpu.crypto.bls12_381 import R
+
+    pvs = [MockPV.from_secret(seed + b"%d" % i) for i in range(n)]
+    vals, privs = [], {}
+    for i, pv in enumerate(pvs):
+        import hashlib
+
+        scalar = (
+            int.from_bytes(
+                hashlib.sha256(seed + b"bls%d" % i).digest(), "big"
+            )
+            % (R - 1)
+            + 1
+        )
+        pub = bls.pubkey_from_priv(scalar)
+        addr = pv.get_pub_key().address()
+        privs[addr] = scalar
+        vals.append(
+            Validator(
+                pv.get_pub_key(), power,
+                bls_pub_key=bls.g2_to_bytes(pub.key),
+            )
+        )
+    vs = ValidatorSet(vals)
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    return vs, [by_addr[v.address] for v in vs.validators], privs
 
 
 def sign_commit(
@@ -60,9 +98,18 @@ def sign_commit(
     block_id: BlockID,
     chain_id: str = CHAIN_ID,
     time_ns: int = T0,
+    bls_privs: dict | None = None,
 ) -> Commit:
-    """All validators precommit block_id; returns the Commit."""
+    """All validators precommit block_id; returns the Commit. With
+    `bls_privs` (make_qc_validators' scalar map) every vote also
+    carries a QC dual-signature, so the commit compresses via
+    assemble_qc."""
     votes = VoteSet(chain_id, height, round_, VoteType.PRECOMMIT, vs)
+    qc_msg = None
+    if bls_privs is not None:
+        from tendermint_tpu.types.quorum_cert import qc_sign_bytes
+
+        qc_msg = qc_sign_bytes(chain_id, height, round_, block_id)
     for i, pv in enumerate(pvs):
         v = Vote(
             type=VoteType.PRECOMMIT,
@@ -74,5 +121,11 @@ def sign_commit(
             validator_index=i,
         )
         pv.sign_vote(chain_id, v)
+        if qc_msg is not None:
+            from tendermint_tpu.crypto import bls_signatures as bls
+
+            v.qc_signature = bls.g1_to_bytes(
+                bls.sign(bls_privs[v.validator_address], qc_msg)
+            )
         votes.add_vote(v, verified=True)
     return votes.make_commit()

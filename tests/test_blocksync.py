@@ -47,12 +47,14 @@ def test_pool_requests_and_windows():
     assert sent, "no requests made"
     for h in range(1, 6):
         pool.add_block("p1", _fake_block(h))
-    # window requires each block's successor to be present
+    # window requires each block's successor to be present; entries are
+    # (block, successor_commit, successor_qc) — qc None on legacy blocks
     w = pool.peek_window(10)
-    assert [b.header.height for b, _c in w] == [1, 2, 3, 4]
+    assert [b.header.height for b, _c, _qc in w] == [1, 2, 3, 4]
+    assert all(qc is None for _b, _c, qc in w)
     pool.pop_request()
     w = pool.peek_window(2)
-    assert [b.header.height for b, _c in w] == [2, 3]
+    assert [b.header.height for b, _c, _qc in w] == [2, 3]
 
 
 def test_pool_redo_punishes_peer():
@@ -123,7 +125,7 @@ def test_slow_peer_banned_sync_completes_via_fast_peer():
     for h in range(2, 7):
         pool.add_block("fast", _fake_block(h), size=4096)
     w = pool.peek_window(10)
-    assert [b.header.height for b, _c in w] == [1, 2, 3, 4, 5]
+    assert [b.header.height for b, _c, _qc in w] == [1, 2, 3, 4, 5]
     assert "fast" in {p.peer_id for p in pool._peers.values()}
 
 

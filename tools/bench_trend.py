@@ -65,6 +65,11 @@ _FAMILY_PREFIXES = (
     ("sequencer", "sequencer_stream"),
     ("commit_", "commit_path"),
     ("wal_", "commit_path"),
+    # QC round compression (PR 14): headline blocksync_commits_per_s@N
+    # must classify under qc_catchup, so this prefix outranks the plain
+    # blocksync family below
+    ("blocksync_commits_per_s", "qc_catchup"),
+    ("qc_", "qc_catchup"),
     ("blocksync", "blocksync"),
     ("quorum_", "consensus"),
     ("vote_latency", "crypto"),
@@ -90,6 +95,10 @@ TIER1_FAMILIES = frozenset(
         # the split-brain verify plane (PR 13): headline is
         # wall-per-height at 32 validators with real crypto over IPC
         "verify_service",
+        # QC round compression (PR 14): headline is
+        # blocksync_commits_per_s@100 (direction higher) — a QC
+        # regression gates like every other plane
+        "qc_catchup",
         "commit_path",
         "blocksync",
         "multichip",

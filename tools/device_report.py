@@ -126,6 +126,29 @@ def report_text(summary: dict, name: str = "") -> str:
                 f"{acct.get('submissions', 0):>7} "
                 f"{_fmt_s(acct.get('queue_wait_seconds', 0.0)):>12}"
             )
+    per_engine = summary.get("per_engine") or {}
+    if per_engine:
+        lines.append("")
+        lines.append(
+            "per-engine (the honest requests/dispatch axis — fn rounds "
+            "are one submission each by construction):"
+        )
+        lines.append(
+            f"{'engine':<14} {'rounds':>7} {'rows':>10} {'disp':>10} "
+            f"{'fill':>6} {'reqs/disp':>10} {'device':>12}"
+        )
+        for eng, acct in sorted(
+            per_engine.items(),
+            key=lambda kv: -kv[1].get("device_seconds", 0.0),
+        ):
+            lines.append(
+                f"{eng:<14} {acct.get('rounds', 0):>7} "
+                f"{acct.get('rows_requested', 0):>10} "
+                f"{acct.get('rows_dispatched', 0):>10} "
+                f"{acct.get('fill_ratio', 0.0):>6.2f} "
+                f"{acct.get('requests_per_dispatch', 0.0):>10} "
+                f"{_fmt_s(acct.get('device_seconds', 0.0)):>12}"
+            )
     per_client = summary.get("per_client") or {}
     if per_client:
         total_rows = sum(

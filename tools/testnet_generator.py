@@ -115,7 +115,8 @@ def peer_indices(topology: str, i: int, n: int) -> list[int]:
 
 
 def materialize(
-    manifest: dict, base: str, free_ports, verify_service: str = ""
+    manifest: dict, base: str, free_ports, verify_service: str = "",
+    quorum_certificates: bool = False,
 ) -> dict:
     """Create node homes for the manifest. `free_ports(n)` supplies
     distinct free localhost ports. `verify_service` (a UDS path) stamps
@@ -182,6 +183,8 @@ def materialize(
     powers = [n.get("power", 1000) for n in validators]
     if len(set(powers)) > 1:
         _patch_genesis_powers(homes, powers)
+    if quorum_certificates:
+        _stamp_qc_keys(homes, len(validators))
 
     ids = [
         NodeKey.load_or_generate(
@@ -198,12 +201,45 @@ def materialize(
         if verify_service:
             # absolute: every home must resolve the SAME socket
             cfg.scheduler.remote_socket = os.path.abspath(verify_service)
+        if quorum_certificates:
+            cfg.consensus.quorum_certificates = True
         peers = peer_indices(manifest["topology"], i, n)
         cfg.p2p.persistent_peers = ",".join(
             f"{ids[j]}@127.0.0.1:{p2p_ports[j]}" for j in peers
         )
         cfg.save()
     return out
+
+
+def _stamp_qc_keys(homes: list[str], n_validators: int) -> None:
+    """QC-capable net: generate each validator's BLS key file now (the
+    node would lazily generate it at first boot anyway) and commit the
+    raw G2 public keys into EVERY home's genesis — all homes must
+    rewrite the identical doc or the net splits on genesis hash, the
+    _patch_genesis_powers rule."""
+    from tendermint_tpu.crypto import bls_signatures as bls
+
+    raw_keys = []
+    for i in range(n_validators):
+        key = bls.load_or_gen_bls_key(
+            os.path.join(homes[i], "config", "bls_key.json")
+        )
+        pub = bls.public_key_from_bytes(key.pub_key, trusted_source=True)
+        raw_keys.append(bls.g2_to_bytes(pub.key).hex())
+    for home in homes:
+        path = os.path.join(home, "config", "genesis.json")
+        with open(path) as f:
+            doc = json.load(f)
+        vals = doc.get("validators", [])
+        if len(vals) != n_validators:
+            raise SystemExit(
+                f"genesis has {len(vals)} validators, expected "
+                f"{n_validators}"
+            )
+        for v, raw in zip(vals, raw_keys):
+            v["bls_pub_key"] = raw
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
 
 
 def _patch_genesis_powers(homes: list[str], powers: list[int]) -> None:
@@ -257,6 +293,14 @@ def main(argv) -> int:
         "verify-service process (python -m tendermint_tpu "
         "verify-service --socket SOCKET)",
     )
+    ap.add_argument(
+        "--qc",
+        action="store_true",
+        help="QC-capable net: generate per-validator BLS keys, commit "
+        "them into every genesis (bls_pub_key), and stamp [consensus] "
+        "quorum_certificates = true across the homes — commits then "
+        "carry one aggregate certificate next to the full commit",
+    )
     args = ap.parse_args(argv[1:])
     manifest = generate_manifest(
         args.seed,
@@ -283,6 +327,7 @@ def main(argv) -> int:
             args.outdir,
             free_ports,
             verify_service=args.verify_service,
+            quorum_certificates=args.qc,
         )
         print(json.dumps(layout, indent=2))
     return 0
