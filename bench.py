@@ -605,6 +605,11 @@ def main() -> None:
     # run the in-proc net once; the attribution ships as the breakdown
     # and the quorum-close lags join the bench family as scalars
     height_attribution = _bench_height_attribution()
+    conservation = (
+        height_attribution.pop("wall_conservation", None)
+        if height_attribution
+        else None
+    )
     print(
         json.dumps(
             {
@@ -647,6 +652,10 @@ def main() -> None:
                 # step + WAL/store/verify spans) — the scalar above finally
                 # ships with its breakdown
                 "latency_attribution": height_attribution,
+                # the exhaustive per-height bucket decomposition; buckets
+                # must sum to measured wall (bench_trend rejects rows
+                # that violate it) and dark_time is gated
+                "wall_conservation": conservation,
             }
         )
     )
@@ -734,13 +743,13 @@ def _bench_consensus_pacing(heights: int = 10, warm: int = 4) -> dict:
             return wall, snap
 
         wall, snap = asyncio.run(run())
-        att = obs.wall_attribution(
-            [r.to_json() for r in tracer.records()]
-        )
+        recs = [r.to_json() for r in tracer.records()]
+        att = obs.wall_attribution(recs)
         return {
             "wall_ms": round(wall * 1e3, 1),
             "floor_share": (att["aggregate"] or {}).get("floor_share"),
             "pacing": snap,
+            "conservation": obs.wall_conservation(recs),
         }
 
     ledger_mark = _ledger_mark()
@@ -764,6 +773,7 @@ def _bench_consensus_pacing(heights: int = 10, warm: int = 4) -> dict:
         ),
         "meta": _meta_block(),
         "device_cost": _device_cost_block(ledger_mark),
+        "wall_conservation": adaptive["conservation"],
         "extra_metrics": [
             {
                 "metric": "consensus_pacing_timeout_floor_share_static",
@@ -1734,6 +1744,11 @@ def _bench_height_attribution():
                     "p50_ms": round(sketch.quantile(0.5), 3),
                     "p95_ms": round(sketch.quantile(0.95), 3),
                 }
+            # the conservation audit over the same capture: every
+            # height's wall decomposed into exhaustive named buckets,
+            # residue = dark_time (tools/bench_trend.py validates the
+            # sum and gates on the dark fraction)
+            att["wall_conservation"] = obs.wall_conservation(recs)
             return att
         finally:
             tracer.enabled = was_enabled
@@ -1895,13 +1910,24 @@ def _extra_metrics(cached_fn, tables, valid, idx, rb, sb, kb, s_ok) -> list:
         assert all(
             vs_r.verify_commits_light(CHAIN_ID, entries, verifier=verifier)
         )
-        rate = n_blocks * n_vals / (time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        # commits/s, not sigs/s (ROADMAP item 3d): now that the QC
+        # plane verifies a commit as ONE aggregate, sigs/s stopped
+        # being the unit replay throughput is bought in — the N-sig
+        # row here prices the LEGACY path in the same commits/s unit
+        # the qc_catchup family's blocksync_commits_per_s reports, so
+        # the two are directly comparable. vs_baseline keeps the
+        # serial-CPU reference, also converted to commits/s.
+        rate = n_blocks / dt
         out.append(
             {
-                "metric": "blocksync_replay_throughput",
+                "metric": "blocksync_replay_commits_per_s",
                 "value": round(rate, 1),
-                "unit": "sigs/s (windowed multi-commit)",
-                "vs_baseline": round(rate / BASELINE_SERIAL_SIGS_PER_S, 3),
+                "unit": f"commits/s ({n_vals}-validator N-sig path, "
+                "windowed multi-commit)",
+                "vs_baseline": round(
+                    rate / (BASELINE_SERIAL_SIGS_PER_S / n_vals), 3
+                ),
                 **_shape_stats(before),
             }
         )

@@ -487,6 +487,7 @@ def cmd_verify_service(args) -> int:
         prewarm=args.prewarm,
         logger=default_logger(),
         ready_fd=args.ready_fd if args.ready_fd >= 0 else None,
+        trace=args.trace,
     )
 
 
@@ -637,6 +638,13 @@ def main(argv=None) -> int:
         default=-1,
         help="fd that gets one JSON readiness line once the socket "
         "accepts (harness use)",
+    )
+    sp.add_argument(
+        "--trace",
+        action="store_true",
+        help="record queue/dispatch/device sub-spans for traced client "
+        "submissions into a service-side flight ring (served at "
+        "GET /dump_traces on --stats-port; TM_TPU_TRACE=1 also enables)",
     )
     sp.set_defaults(fn=cmd_verify_service)
 

@@ -509,6 +509,12 @@ class HealthConfig:
     cache_hit_floor: float = 0.9
     # event-loop lag above this is a bad event (PR 9: loop-bound nets)
     loop_lag_warn: float = 0.05
+    # wall-clock conservation (obs.report.wall_conservation over the
+    # flight ring, tracing on): a committed height whose dark_time
+    # residue — wall not claimed by ANY named bucket — exceeds this
+    # fraction is a bad event; sustained dark time means latency with
+    # no instrumented owner
+    dark_time_floor: float = 0.05
     # stalled-round ceiling = this factor x the static round-0 timeout
     # schedule (propose + prevote + precommit + commit waits)
     stall_factor: float = 3.0
@@ -527,6 +533,8 @@ class HealthConfig:
             raise ValueError("health.cache_hit_floor must be in (0, 1)")
         if not (0.0 < self.fill_floor < 1.0):
             raise ValueError("health.fill_floor must be in (0, 1)")
+        if not (0.0 < self.dark_time_floor < 1.0):
+            raise ValueError("health.dark_time_floor must be in (0, 1)")
         if self.fill_min_rows < 1:
             raise ValueError("health.fill_min_rows must be >= 1")
         for f in (

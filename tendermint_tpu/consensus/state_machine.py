@@ -33,6 +33,7 @@ from ..crypto.batch_verifier import BatchVerifier, SigItem, default_verifier
 from ..l2node.l2node import BlockData, BlsData, L2Node
 from ..libs import fail
 from ..obs import default_tracer
+from ..obs.tracer import set_height_hint
 from ..libs.events import EventSwitch
 from ..libs.log import Logger, nop_logger
 from ..state.execution import BlockExecutor
@@ -560,6 +561,10 @@ class ConsensusState:
             )
         name = rs.step.name.lower()
         self._cur_step = (name, now, rs.height, rs.round)
+        # publish the height/round in progress for seams that submit
+        # work on this node's behalf without seeing a height (the
+        # remote verify client stamps it into wire trace context)
+        set_height_hint(rs.height, rs.round)
         if name == "prevote":
             self._prevote_started = (rs.height, rs.round, now)
         self.event_switch.fire_event(EVENT_NEW_ROUND_STEP, self.rs)

@@ -301,6 +301,10 @@ class Node(Service):
             self.health_monitor.bind_wal(
                 consensus_metrics.wal_fsync_seconds
             )
+            # wall-clock conservation: the dark_time detector audits
+            # the flight ring per committed height (no-op while the
+            # tracer is disabled)
+            self.health_monitor.bind_tracer(self.tracer)
 
         self.state_store = StateStore(make_kv("state"))
         if config.commit_pipeline.enable:
@@ -485,6 +489,9 @@ class Node(Service):
                     config.path(config.scheduler.remote_socket),
                     logger=self.logger,
                     tracer=self.tracer,
+                    # wire trace context names this node as the
+                    # submitter in the service's sub-spans
+                    origin=self.node_key.id[:16],
                 )
             )
             self.logger.info(

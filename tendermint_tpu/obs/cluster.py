@@ -34,7 +34,7 @@ import heapq
 
 from .report import pct
 
-REPORT_SCHEMA = "tm-tpu/cluster-report/v1"
+REPORT_SCHEMA = "tm-tpu/cluster-report/v2"
 
 # dumps whose offset came from the NTP peer graph vs the raw wall clock
 SOURCE_NTP = "ntp_graph"
@@ -418,6 +418,72 @@ def straggler_ranking(merged: list[dict]) -> list[dict]:
     return out
 
 
+def verify_flow(merged: list[dict]) -> dict:
+    """Cross-process verify attribution: join each node's client-side
+    `verify.ipc` round-trip span with the verify-SERVICE's
+    `verify.queue`/`verify.device`/`verify.service` sub-spans recorded
+    under the same wire trace context (matched on origin + request id).
+    The service dump merges on the raw-wall-anchor fallback (it sits
+    outside the p2p NTP graph), so the join uses DURATIONS, never
+    cross-ring timestamps: wire overhead = client RTT minus the
+    service-observed handle time, which both clocks agree on.
+
+    Returns per-height rows plus an aggregate — the verify slice of the
+    wall-conservation story across the process split."""
+    svc: dict[tuple, dict] = {}
+    for r in merged:
+        name = r.get("name", "")
+        if name not in ("verify.queue", "verify.device", "verify.service"):
+            continue
+        f = r.get("fields") or {}
+        key = (f.get("origin", ""), f.get("req", -1))
+        sub = svc.setdefault(key, {})
+        # ACCUMULATE: a submission larger than the scheduler's
+        # max_batch dispatches as several device rounds, each recording
+        # its own queue/device sub-span under the same (origin, req) —
+        # last-write-wins would drop all but one round's time
+        sub[name] = sub.get(name, 0.0) + r.get("dur", 0.0)
+    heights: dict[int, dict] = {}
+    joined = 0
+    for r in merged:
+        if r.get("name") != "verify.ipc":
+            continue
+        f = r.get("fields") or {}
+        key = (f.get("origin", ""), f.get("req", -1))
+        sub = svc.get(key, {})
+        rtt = r.get("dur", 0.0)
+        service = sub.get("verify.service", 0.0)
+        row = heights.setdefault(
+            r.get("height", 0),
+            {
+                "submissions": 0,
+                "joined": 0,
+                "rows": 0,
+                "ipc_ms": 0.0,
+                "queue_ms": 0.0,
+                "device_ms": 0.0,
+                "wire_ms": 0.0,
+            },
+        )
+        row["submissions"] += 1
+        row["rows"] += int(f.get("n", 0))
+        row["ipc_ms"] += rtt * 1e3
+        if sub:
+            joined += 1
+            row["joined"] += 1
+            row["queue_ms"] += sub.get("verify.queue", 0.0) * 1e3
+            row["device_ms"] += sub.get("verify.device", 0.0) * 1e3
+            row["wire_ms"] += max(0.0, rtt - service) * 1e3
+    for row in heights.values():
+        for k in ("ipc_ms", "queue_ms", "device_ms", "wire_ms"):
+            row[k] = round(row[k], 3)
+    return {
+        "submissions": sum(r["submissions"] for r in heights.values()),
+        "joined": joined,
+        "heights": {str(h): heights[h] for h in sorted(heights)},
+    }
+
+
 def wall_anchor_offsets(dumps: list[dict]) -> dict:
     """All-zero offsets (source wall_anchor): trust each node's wall
     clock as ground truth. The right merge basis for in-proc harnesses
@@ -469,6 +535,9 @@ def cluster_report(
         },
         "links": link_latencies(merged, dumps),
         "stragglers": straggler_ranking(merged),
+        # cross-process verify attribution (empty when no verify-service
+        # dump / traced submissions are in the merge)
+        "verify_flow": verify_flow(merged),
     }
 
 
@@ -508,6 +577,24 @@ def report_text(report: dict) -> str:
                 f"min {e['min_lag_ms']:>8.2f} ms  "
                 f"median {e['median_lag_ms']:>8.2f} ms  "
                 f"p95 {e['p95_lag_ms']:>8.2f} ms  ({e['samples']} msgs)"
+            )
+    vf = report.get("verify_flow") or {}
+    if vf.get("submissions"):
+        lines.append("")
+        lines.append(
+            f"  verify flow ({vf['submissions']} traced submissions, "
+            f"{vf['joined']} joined to service sub-spans):"
+        )
+        lines.append(
+            f"    {'height':>6} {'subs':>5} {'rows':>6} {'ipc_ms':>9} "
+            f"{'queue_ms':>9} {'device_ms':>9} {'wire_ms':>9}"
+        )
+        for h in sorted(vf["heights"], key=int):
+            r = vf["heights"][h]
+            lines.append(
+                f"    {h:>6} {r['submissions']:>5} {r['rows']:>6} "
+                f"{r['ipc_ms']:>9.2f} {r['queue_ms']:>9.2f} "
+                f"{r['device_ms']:>9.2f} {r['wire_ms']:>9.2f}"
             )
     if report["stragglers"]:
         lines.append("")

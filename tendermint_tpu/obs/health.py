@@ -13,9 +13,11 @@ closes the loop in-process:
   scheduler saturation (queue depth vs dispatch progress), WAL fsync
   latency drift, the sequencer receipt->applied SLO (PR 10's 96 ms p95
   as the default target), the lightserve cache hit-rate floor, peer
-  flap, and an event-loop lag probe (a monotonic heartbeat task — the
+  flap, an event-loop lag probe (a monotonic heartbeat task — the
   PR 9 finding that live nets go event-loop-bound above ~32 validators,
-  measured instead of inferred).
+  measured instead of inferred), and the dark_time conservation
+  watchdog (per-height wall time with NO instrumented owner, from
+  obs.report.wall_conservation over the bound flight ring).
 
 - **Burn-rate SLOs** (the SRE multiwindow pattern) roll each detector's
   event stream into ok/warn/critical: burn = bad_fraction /
@@ -601,6 +603,31 @@ class PeerFlapDetector(Detector):
         self._observe(t, float(count), bad=count < prev)
 
 
+class DarkTimeDetector(Detector):
+    """Wall-clock conservation watchdog: every committed height's wall
+    time decomposes into named buckets (obs.report.wall_conservation —
+    step compute, gossip wait, timeout floor, verify IPC/queue/device,
+    WAL fsync, commit pipeline) with the residue booked as `dark_time`.
+    A height whose dark fraction exceeds the floor is a bad event: some
+    slice of latency has NO instrumented owner — a new blocking seam, a
+    starved event loop between step transitions, a span that stopped
+    being recorded. The whole point of the conservation invariant is
+    that such time can no longer hide; this detector is the part that
+    pages about it. Fed per-height from the bound tracer's ring on the
+    monitor tick (skipping heights already judged)."""
+
+    subsystem = "consensus"
+    name = "dark_time"
+
+    def __init__(self, slo: BurnRateSLO, floor: float = 0.05):
+        super().__init__(slo)
+        self.floor = floor
+        self.last_threshold = floor
+
+    def observe_height(self, t: float, dark_fraction: float) -> None:
+        self._observe(t, dark_fraction, bad=dark_fraction > self.floor)
+
+
 class EventLoopLagDetector(Detector):
     """Event-loop scheduling lag: the heartbeat task measures how late
     the loop runs a due callback. PR 9 showed live nets above ~32
@@ -650,6 +677,7 @@ class HealthMonitor:
         sequencer_apply_target_s: float = 0.1,
         cache_hit_floor: float = 0.9,
         loop_lag_warn_s: float = 0.05,
+        dark_time_floor: float = 0.05,
         tracer=None,
         metrics=None,
         process_metrics=None,
@@ -725,11 +753,17 @@ class HealthMonitor:
             slo("event_loop_lag", objective=0.9, min_events=8),
             lag_warn_s=loop_lag_warn_s,
         )
+        self.dark_time = DarkTimeDetector(
+            # 1 unconserved height in 10 burns the budget at exactly 1x
+            slo("dark_time", objective=0.9, min_events=4),
+            floor=dark_time_floor,
+        )
         self.detectors: dict[str, Detector] = {
             d.name: d
             for d in (
                 self.round_churn,
                 self.stalled_round,
+                self.dark_time,
                 self.quorum_lag,
                 self.scheduler_saturation,
                 self.fill_efficiency,
@@ -753,6 +787,8 @@ class HealthMonitor:
         self._sequencer_hist = None
         self._lightserve_metrics = None
         self._switch = None
+        self._conservation_tracer = None
+        self._dark_seen_height = 0
         self._cum: dict[str, float] = {}
         self._tasks: list[asyncio.Task] = []
         self._running = False
@@ -778,6 +814,7 @@ class HealthMonitor:
             sequencer_apply_target_s=hc.sequencer_apply_target,
             cache_hit_floor=hc.cache_hit_floor,
             loop_lag_warn_s=hc.loop_lag_warn,
+            dark_time_floor=getattr(hc, "dark_time_floor", 0.05),
             **kw,
         )
 
@@ -849,6 +886,15 @@ class HealthMonitor:
     def bind_switch(self, switch) -> None:
         self._switch = switch
 
+    def bind_tracer(self, tracer) -> None:
+        """obs.tracer.Tracer (the node's flight ring): each tick the
+        dark_time detector runs the wall-conservation audit
+        (obs.report.wall_conservation) over recent records and judges
+        every COMPLETED height not yet seen — the in-progress height's
+        window is still growing, so it is never judged early. No-ops
+        while the tracer is disabled (no records, nothing to conserve)."""
+        self._conservation_tracer = tracer
+
     # --- sampling ---------------------------------------------------------
 
     def _delta(self, key: str, cum: float) -> Optional[float]:
@@ -891,6 +937,7 @@ class HealthMonitor:
             ("sequencer", self._pull_sequencer),
             ("lightserve", self._pull_lightserve),
             ("p2p", self._pull_switch),
+            ("conservation", self._pull_conservation),
         ):
             try:
                 pull(now)
@@ -974,6 +1021,40 @@ class HealthMonitor:
     def _pull_switch(self, now: float) -> None:
         if self._switch is not None:
             self.peer_flap.observe_count(now, len(self._switch.peers))
+
+    def _pull_conservation(self, now: float) -> None:
+        tr = self._conservation_tracer
+        if tr is None or not getattr(tr, "enabled", False):
+            return
+        from .report import wall_conservation
+
+        # SpanRecords pass straight through (no to_json round trip on
+        # the tick path), pre-filtered to heights not yet judged —
+        # heightless records (WAL fsyncs, scheduler rounds) are kept
+        # for window binning; ones belonging to already-judged heights
+        # find no window in the filtered set and drop out
+        seen = self._dark_seen_height
+        cons = wall_conservation(
+            [
+                r
+                for r in tr.records()
+                if r.height == 0 or r.height > seen
+            ],
+            n_heights=8,
+        )
+        heights = cons.get("heights") or {}
+        if not heights:
+            return
+        tip = max(heights)
+        for h in sorted(heights):
+            # the tip height's window is still growing — judge only
+            # completed heights, each exactly once
+            if h >= tip or h <= self._dark_seen_height:
+                continue
+            self._dark_seen_height = h
+            self.dark_time.observe_height(
+                now, heights[h]["dark_fraction"]
+            )
 
     # --- verdict roll-up + incident emission ------------------------------
 

@@ -167,6 +167,230 @@ def wall_attribution(
     }
 
 
+# --- wall-clock conservation ------------------------------------------------
+#
+# wall_attribution above answers "how do the STEP spans split a height";
+# wall_conservation answers the stricter question ROADMAP item 4 needs:
+# does EVERY slice of a height's measured wall clock have a name? The
+# decomposition is mutually exclusive and exhaustive by construction —
+# each elementary time segment of the height window is assigned to
+# exactly one bucket by a priority sweep — so the buckets plus the
+# `dark_time` residue sum to the measured wall exactly, and unexplained
+# latency can never hide inside an "other" that also absorbs known
+# overlap error.
+
+CONSERVATION_SCHEMA = "tm-tpu/wall-conservation/v1"
+
+# carve buckets, HIGHEST priority first: a segment covered by several
+# span families is charged to the first bucket here that claims it.
+# Device time outranks queue wait (the queue_wait span of a round ends
+# where its device span begins, but service-side merges can overlap),
+# and the client-observed IPC round trip ranks below both so that when
+# service sub-spans are present (one merged timeline) the RTT only
+# keeps the wire/serialization slice the service can't see.
+CONSERVATION_CARVES: tuple[tuple[str, frozenset], ...] = (
+    ("verify_device", frozenset({"scheduler.device_round", "verify.device"})),
+    ("verify_queue", frozenset({"scheduler.queue_wait", "verify.queue"})),
+    ("verify_ipc", frozenset({"verify.ipc"})),
+    ("wal_fsync", frozenset({"wal.fsync", "wal.group_fsync"})),
+    (
+        "commit_pipeline",
+        frozenset({"commit.pipeline_wait", "store.save_block"}),
+    ),
+)
+
+CONSERVATION_BUCKETS = tuple(
+    [name for name, _ in CONSERVATION_CARVES]
+    + ["floor", "gossip", "compute", "dark_time"]
+)
+
+_STEP_SPANS = frozenset(STEP_ORDER)
+
+# derived lookups for the sweep (pure functions of the carve table)
+_CARVE_PRIO = {name: i for i, (name, _) in enumerate(CONSERVATION_CARVES)}
+_CARVE_OF = {
+    span: name for name, spans in CONSERVATION_CARVES for span in spans
+}
+
+
+def _step_bucket(name: str) -> str:
+    if name in WALL_FLOOR_SPANS:
+        return "floor"
+    if name in WALL_GOSSIP_SPANS:
+        return "gossip"
+    return "compute"
+
+
+def wall_conservation(records: list[dict], n_heights: int = 64) -> dict:
+    """Per-height exhaustive wall-clock decomposition. The height window
+    is the span of its cs.* step records (they tile the height by
+    construction: `_new_step` closes each at the transition to the
+    next); carve spans — verify IPC/queue/device, WAL fsync, the commit
+    pipeline wait — claim their segments out of the containing step's
+    bucket, the step classification (floor/gossip/compute) takes what
+    remains, and any segment covered by NO span at all lands in
+    `dark_time`. Invariant: sum(buckets) == wall per height (float eps);
+    the `conserved` flag in the aggregate attests it was checked.
+    Accepts record dicts (dump files, RPC responses) or SpanRecord
+    objects directly (the health plane's per-tick pull skips the
+    serialize/deserialize round trip)."""
+    recs = [
+        r if isinstance(r, SpanRecord) else SpanRecord.from_json(r)
+        for r in records
+    ]
+    flight = flight_snapshot(recs, n_heights)
+    heights: dict[int, dict] = {}
+    conserved = True
+    for h, rows in flight.items():
+        steps = [
+            r
+            for r in rows
+            if r["kind"] == "span" and r["name"] in _STEP_SPANS
+        ]
+        if not steps:
+            continue
+        w0 = min(r["t0"] for r in steps)
+        w1 = max(r["t0"] + r.get("dur", 0.0) for r in steps)
+        wall = w1 - w0
+        if wall <= 0:
+            continue
+        # (start, end, bucket, priority) clipped to the window
+        intervals: list[tuple[float, float, str, int]] = []
+        for r in rows:
+            if r["kind"] != "span":
+                continue
+            bucket = _CARVE_OF.get(r["name"])
+            if bucket is None:
+                continue
+            s = max(w0, r["t0"])
+            e = min(w1, r["t0"] + r.get("dur", 0.0))
+            if e > s:
+                intervals.append((s, e, bucket, _CARVE_PRIO[bucket]))
+        base = len(CONSERVATION_CARVES)
+        for r in steps:
+            s = max(w0, r["t0"])
+            e = min(w1, r["t0"] + r.get("dur", 0.0))
+            if e > s:
+                intervals.append((s, e, _step_bucket(r["name"]), base))
+        # priority sweep over elementary segments: every edge point
+        # starts a segment owned by the highest-priority cover (or dark)
+        edges = sorted(
+            {w0, w1}
+            | {iv[0] for iv in intervals}
+            | {iv[1] for iv in intervals}
+        )
+        buckets = {name: 0.0 for name in CONSERVATION_BUCKETS}
+        for a, b in zip(edges, edges[1:]):
+            cover = [iv for iv in intervals if iv[0] <= a and iv[1] >= b]
+            if cover:
+                buckets[min(cover, key=lambda iv: iv[3])[2]] += b - a
+            else:
+                buckets["dark_time"] += b - a
+        total = sum(buckets.values())
+        if abs(total - wall) > 1e-6 * max(1.0, wall):
+            conserved = False
+        heights[h] = {
+            "wall_ms": round(wall * 1e3, 3),
+            **{
+                f"{name}_ms": round(v * 1e3, 3)
+                for name, v in buckets.items()
+            },
+            "dark_fraction": round(buckets["dark_time"] / wall, 4),
+        }
+    if not heights:
+        return {
+            "schema": CONSERVATION_SCHEMA,
+            "heights": {},
+            "aggregate": {},
+        }
+    walls = [v["wall_ms"] for v in heights.values()]
+    total_wall = sum(walls)
+    shares = {
+        f"{name}_share": round(
+            sum(v[f"{name}_ms"] for v in heights.values()) / total_wall, 4
+        )
+        for name in CONSERVATION_BUCKETS
+    }
+    return {
+        "schema": CONSERVATION_SCHEMA,
+        "heights": heights,
+        "aggregate": {
+            "n_heights": len(heights),
+            "wall_ms_p50": round(pct(walls, 0.5), 3),
+            "wall_ms_p95": round(pct(walls, 0.95), 3),
+            "wall_ms_max": round(max(walls), 3),
+            **shares,
+            "dark_fraction": shares["dark_time_share"],
+            "dark_fraction_max": max(
+                v["dark_fraction"] for v in heights.values()
+            ),
+            "conserved": conserved,
+        },
+    }
+
+
+def check_conservation(block: dict, tolerance: float = 0.002) -> list[str]:
+    """Schema validation for a wall_conservation block (bench artifacts,
+    tools/bench_trend.py): every height's buckets must sum to its wall
+    within `tolerance` (fractional), and the aggregate must carry the
+    dark_fraction fields. Returns a list of violation strings (empty =
+    valid)."""
+    errs: list[str] = []
+    if not isinstance(block, dict):
+        return ["wall_conservation is not an object"]
+    agg = block.get("aggregate")
+    if not isinstance(agg, dict):
+        return ["wall_conservation.aggregate missing"]
+    if not agg:
+        return []  # empty capture: nothing to conserve
+    for key in ("dark_fraction", "n_heights"):
+        if key not in agg:
+            errs.append(f"aggregate.{key} missing")
+    for h, row in (block.get("heights") or {}).items():
+        wall = row.get("wall_ms")
+        if wall is None:
+            errs.append(f"height {h}: wall_ms missing")
+            continue
+        covered = sum(
+            row.get(f"{name}_ms", 0.0) for name in CONSERVATION_BUCKETS
+        )
+        if wall > 0 and abs(covered - wall) > tolerance * wall:
+            errs.append(
+                f"height {h}: buckets sum to {covered:.3f} ms != wall "
+                f"{wall:.3f} ms"
+            )
+    return errs
+
+
+def conservation_table(cons: dict) -> str:
+    """The wall_conservation dict as an aligned text table."""
+    agg = cons.get("aggregate") or {}
+    if not agg:
+        return "(no step spans in dump — conservation needs cs.* records)"
+    lines = [
+        f"wall-clock conservation over {agg['n_heights']} heights "
+        f"(dark {agg['dark_fraction']:.1%}, worst height "
+        f"{agg['dark_fraction_max']:.1%})",
+        "  shares: "
+        + "  ".join(
+            f"{name} {agg.get(f'{name}_share', 0.0):.1%}"
+            for name in CONSERVATION_BUCKETS
+        ),
+        f"  {'height':>8} {'wall_ms':>9} "
+        + " ".join(f"{n[:9]:>9}" for n in CONSERVATION_BUCKETS),
+    ]
+    for h in sorted(cons.get("heights") or {}, key=int):
+        v = cons["heights"][h]
+        lines.append(
+            f"  {h:>8} {v['wall_ms']:>9.2f} "
+            + " ".join(
+                f"{v.get(f'{n}_ms', 0.0):>9.2f}"
+                for n in CONSERVATION_BUCKETS
+            )
+        )
+    return "\n".join(lines)
+
+
 def pacing_decisions(records: list[dict]) -> dict:
     """Per-step learned-vs-static summary from `pacing.decision` trace
     events (consensus/pacing.py emits one per step per height)."""

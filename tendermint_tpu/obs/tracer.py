@@ -333,6 +333,31 @@ def flight_snapshot(
     }
 
 
+# --- consensus height hint --------------------------------------------------
+# The state machine publishes its current (height, round) here on every
+# step transition; seams that submit work on the consensus node's behalf
+# but never see a height (the remote verify client stamping trace
+# context onto UDS submissions) read it back. A plain module tuple —
+# atomic under the GIL, one attribute store per step transition. In-proc
+# multi-node harnesses share it (last writer wins), which is fine for a
+# HINT: the real deployment runs one consensus instance per process, and
+# harness nodes track within a height of each other.
+
+_height_hint: tuple = (0, 0)
+
+
+def set_height_hint(height: int, round_: int = 0) -> None:
+    """Publish the consensus height/round in progress (state machine)."""
+    global _height_hint
+    _height_hint = (height, round_)
+
+
+def height_hint() -> tuple:
+    """(height, round) last published by the consensus state machine;
+    (0, 0) before consensus starts."""
+    return _height_hint
+
+
 _default: Optional[Tracer] = None
 _default_lock = threading.Lock()
 

@@ -104,10 +104,11 @@ class _Submission:
 
     __slots__ = (
         "items", "klass", "n", "fn", "engine", "verdicts", "remaining",
-        "offset", "future", "t_enq", "failed",
+        "offset", "future", "t_enq", "failed", "ctx", "t_progress",
     )
 
-    def __init__(self, items, klass, future, fn=None, engine="fn"):
+    def __init__(self, items, klass, future, fn=None, engine="fn",
+                 ctx=None):
         self.items = items
         self.klass = klass
         self.n = len(items)
@@ -125,6 +126,17 @@ class _Submission:
         self.offset = 0
         self.future = future
         self.t_enq = time.perf_counter()
+        # trace context (height, round, origin, req) stamped by remote
+        # clients over the UDS wire — the scheduler records this
+        # submission's queue/device sub-spans under it so the caller's
+        # per-height timeline can bill verify time across the process
+        # split. None for untraced (in-proc) submissions. t_progress is
+        # where this submission's NEXT queue span starts: enqueue time
+        # for the first round, the previous round's completion after —
+        # a multi-round submission must not re-bill earlier rounds'
+        # device time as queue wait.
+        self.ctx = ctx
+        self.t_progress = self.t_enq
         # set when a round carrying one of this submission's slices
         # failed: the future already holds the exception, so any
         # not-yet-dispatched remainder is dead work and must be dropped
@@ -177,10 +189,15 @@ class VerifyScheduler:
         metrics: Optional[SchedulerMetrics] = None,
         ledger: Optional[DispatchLedger] = None,
         dispatch_log_size: int = 1024,
+        tracer=None,
     ):
         self.verifier = verifier or default_verifier()
         self.max_batch = max(1, int(max_batch))
         self.logger = logger or nop_logger()
+        # is-None check: an empty Tracer is falsy (it has __len__); when
+        # unset the process default is resolved AT RECORD TIME so a
+        # later set_default_tracer still captures this scheduler
+        self.tracer = tracer
         self.metrics = metrics or default_metrics(SchedulerMetrics)
         # device-cost ledger (obs/ledger.py): every round lands there
         # as a structured entry with per-class rows, fill, queue-wait/
@@ -258,11 +275,13 @@ class VerifyScheduler:
     # --- submission --------------------------------------------------------
 
     async def submit(
-        self, items: list[SigItem], klass: str = "consensus"
+        self, items: list[SigItem], klass: str = "consensus", ctx=None
     ) -> np.ndarray:
         """Queue items under `klass`; resolves to the aligned verdict
         bitmap. Must be awaited on the scheduler's own loop (cross-
-        thread callers use submit_sync)."""
+        thread callers use submit_sync). `ctx` is an optional trace
+        context (height, round, origin, req) — the verify-service
+        passes the one its client stamped on the wire."""
         items = list(items)
         if not items:
             return np.zeros(0, dtype=bool)
@@ -270,11 +289,11 @@ class VerifyScheduler:
             return await asyncio.get_running_loop().run_in_executor(
                 None, self.verifier.verify, items
             )
-        return await self._enqueue(items, klass, fn=None)
+        return await self._enqueue(items, klass, fn=None, ctx=ctx)
 
     async def submit_fn(
         self, items: list, fn: Callable[[list], list],
-        klass: str = "consensus", engine: str = "fn",
+        klass: str = "consensus", engine: str = "fn", ctx=None,
     ):
         """Private-engine lane: `fn(items)` runs as its own round on the
         shared dispatch thread, under the same priority ordering — the
@@ -288,7 +307,9 @@ class VerifyScheduler:
             return await asyncio.get_running_loop().run_in_executor(
                 None, fn, items
             )
-        return await self._enqueue(items, klass, fn=fn, engine=engine)
+        return await self._enqueue(
+            items, klass, fn=fn, engine=engine, ctx=ctx
+        )
 
     async def submit_wire_fn(
         self,
@@ -331,11 +352,11 @@ class VerifyScheduler:
             return fb()
         return self.submit_fn_sync(items, fn, klass, engine=engine)
 
-    async def _enqueue(self, items, klass, fn, engine="fn"):
+    async def _enqueue(self, items, klass, fn, engine="fn", ctx=None):
         if klass not in self._queues:
             klass = "blocksync"  # unknown classes ride the bulk lane
         fut = self._loop.create_future()
-        sub = _Submission(items, klass, fut, fn=fn, engine=engine)
+        sub = _Submission(items, klass, fut, fn=fn, engine=engine, ctx=ctx)
         self._queues[klass].append(sub)
         self._wakeup.set()
         # gauge scope = submitted until verdicts resolve (in flight)
@@ -530,7 +551,7 @@ class VerifyScheduler:
             self._fail_slices(slices, e)
             return None
         prep_s = time.perf_counter() - t0
-        default_tracer().add_span(
+        self._trace().add_span(
             "scheduler.host_prep",
             t0,
             prep_s,
@@ -538,12 +559,40 @@ class VerifyScheduler:
         )
         return prepared.run, getattr(prepared, "devices", 1), prep_s
 
+    def _trace(self):
+        return self.tracer if self.tracer is not None else default_tracer()
+
+    def _ctx_spans(self, tracer, sub, t0: float, dur: float, rows: int):
+        """Per-submission queue/device sub-spans under the submission's
+        wire trace context: the client's height/round land on the
+        SERVICE's ring so the merged cluster timeline can bill a verify
+        round trip's queue and device slices to the height that paid
+        them (the in-proc scheduler.queue_wait/device_round spans carry
+        no height and only bin correctly on the ring that also holds
+        the height's step spans). Queue time starts at t_progress, not
+        t_enq: a later round's wait must exclude the earlier rounds'
+        device time (verify_flow SUMS these durations per request)."""
+        height, round_, origin, req = sub.ctx
+        wait = max(0.0, t0 - sub.t_progress)
+        sub.t_progress = t0 + dur
+        if wait > 0:
+            tracer.add_span(
+                "verify.queue", t0 - wait, wait,
+                height=height, round=round_, origin=origin, req=req,
+                n=rows, klass=sub.klass,
+            )
+        tracer.add_span(
+            "verify.device", t0, dur,
+            height=height, round=round_, origin=origin, req=req,
+            n=rows, klass=sub.klass,
+        )
+
     async def _execute(
         self, round_, run, devices: int = 1, prep_s: float = 0.0
     ) -> None:
         loop = asyncio.get_running_loop()
         kind = round_[0]
-        tracer = default_tracer()
+        tracer = self._trace()
         t0 = time.perf_counter()
         try:
             verdicts = await loop.run_in_executor(self._dispatch_pool, run)
@@ -602,6 +651,8 @@ class VerifyScheduler:
                 "scheduler.device_round", t0, dur,
                 n=sub.n, engine=sub.engine, klass=sub.klass,
             )
+            if sub.ctx is not None:
+                self._ctx_spans(tracer, sub, t0, dur, sub.n)
             return
         _, slices, total = round_
         arr = np.asarray(verdicts)
@@ -663,6 +714,9 @@ class VerifyScheduler:
         tracer.add_span(
             "scheduler.queue_wait", oldest, t0 - oldest, n=total
         )
+        for sub, _, take in slices:
+            if sub.ctx is not None:
+                self._ctx_spans(tracer, sub, t0, dur, take)
         tracer.add_span(
             "scheduler.device_round", t0, dur,
             n=total, bucket=bucket, fill=round(fill, 3),

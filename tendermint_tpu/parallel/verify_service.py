@@ -46,12 +46,20 @@ Wire format (all integers big-endian):
 
     frame    := u32 length | payload            (length = len(payload))
     payload  := u8 type | u64 request_id | body
-    SUBMIT(1)       body := str8 klass | u32 n | n * sigitem
+    SUBMIT(1)       body := str8 klass | u32 n | n * sigitem | [ctx]
     sigitem         := str8 key_type | bytes16 pubkey | bytes32 msg
                        | bytes16 sig
     VERDICTS(2)     body := u32 n | ceil(n/8) bitmap (little-bit-order)
     SUBMIT_FN(3)    body := str8 klass | str8 engine | u32 n | n * item
+                    | [ctx]
     item            := u8 nparts | nparts * bytes32
+    ctx             := u64 height | u32 round | str8 origin
+                    (optional trailer: clients stamp the consensus
+                    height in progress + their identity so the service
+                    records queue/dispatch/device sub-spans under the
+                    submitter's span context; a decoder that stops at
+                    the last item ignores it, so old servers accept new
+                    clients and vice versa)
     FN_RESULTS(4)   body := u32 n | n * (u8 tag | [u32 len | bytes])
                     tag: 0=False 1=True 2=None 3=bytes
     PING(5)/PONG(6) body := opaque (echoed verbatim)
@@ -183,7 +191,31 @@ class _Cursor:
         return self.take(self.u32())
 
 
-def encode_submit(req_id: int, items: list[SigItem], klass: str) -> bytes:
+def _put_trace_ctx(out: list, ctx) -> None:
+    """Optional trace-context trailer: (height, round, origin)."""
+    if ctx is None:
+        return
+    height, round_, origin = ctx
+    out.append(_U64.pack(max(0, int(height))))
+    out.append(_U32.pack(max(0, int(round_))))
+    _put_str8(out, str(origin))
+
+
+def decode_trace_ctx(cur: _Cursor, req_id: int):
+    """The trailer, if the frame carries one; the req_id joins the
+    client's round-trip span to the service's sub-spans. Returns
+    (height, round, origin, req_id) or None."""
+    if cur.off >= len(cur.buf):
+        return None
+    height = _U64.unpack(cur.take(8))[0]
+    round_ = cur.u32()
+    origin = cur.str8()
+    return (height, round_, origin, req_id)
+
+
+def encode_submit(
+    req_id: int, items: list[SigItem], klass: str, ctx=None
+) -> bytes:
     out = [_HDR.pack(MSG_SUBMIT, req_id)]
     _put_str8(out, klass)
     out.append(_U32.pack(len(items)))
@@ -192,6 +224,7 @@ def encode_submit(req_id: int, items: list[SigItem], klass: str) -> bytes:
         _put_bytes16(out, bytes(it.pubkey))
         _put_bytes32(out, bytes(it.msg))
         _put_bytes16(out, bytes(it.sig))
+    _put_trace_ctx(out, ctx)
     return b"".join(out)
 
 
@@ -231,7 +264,7 @@ def decode_verdicts(cur: _Cursor) -> np.ndarray:
 
 
 def encode_submit_fn(
-    req_id: int, engine: str, items: list[tuple], klass: str
+    req_id: int, engine: str, items: list[tuple], klass: str, ctx=None
 ) -> bytes:
     out = [_HDR.pack(MSG_SUBMIT_FN, req_id)]
     _put_str8(out, klass)
@@ -243,6 +276,7 @@ def encode_submit_fn(
         out.append(_U8.pack(len(parts)))
         for p in parts:
             _put_bytes32(out, bytes(p))
+    _put_trace_ctx(out, ctx)
     return b"".join(out)
 
 
@@ -331,10 +365,11 @@ class VerifyServiceServer:
 
     Lifecycle: construct, `await start()` on the serving loop,
     `await stop()`. `stats_port` > 0 additionally serves GET /metrics
-    (the process registry, text exposition) and GET
-    /dump_dispatch_ledger (the same JSON shape as the node RPC route,
-    plus per-client tenant rows) over TCP — `tools/device_report.py`
-    reads those dumps directly."""
+    (the process registry, text exposition), GET /dump_dispatch_ledger
+    (the same JSON shape as the node RPC route, plus per-client tenant
+    rows) and GET /dump_traces (the service flight ring in the node
+    dump_traces shape, mergeable by obs/cluster.py) over TCP —
+    `tools/device_report.py` reads those dumps directly."""
 
     def __init__(
         self,
@@ -347,11 +382,19 @@ class VerifyServiceServer:
         stats_host: str = "127.0.0.1",
         registry: Optional[Registry] = None,
         engines: Optional[dict] = None,
+        tracer=None,
     ):
         self.path = path
         self.logger = logger or nop_logger()
+        # the service's own flight ring: traced client submissions land
+        # their queue/dispatch/device sub-spans here, and GET
+        # /dump_traces on the stats port ships it in the dump_traces
+        # shape so obs/cluster.py merges it next to validator dumps
+        # (is-None check — an empty Tracer is falsy via __len__)
+        self.tracer = tracer
         self.scheduler = scheduler or VerifyScheduler(
-            verifier=verifier, max_batch=max_batch, logger=self.logger
+            verifier=verifier, max_batch=max_batch, logger=self.logger,
+            tracer=tracer,
         )
         self.registry = registry or default_registry()
         self.stats_port = stats_port
@@ -434,6 +477,26 @@ class VerifyServiceServer:
             },
         }
 
+    def _trace(self):
+        from ..obs import default_tracer as _dt
+
+        return self.tracer if self.tracer is not None else _dt()
+
+    def trace_dump(self) -> dict:
+        """The service ring in the node `dump_traces` response shape, so
+        obs.cluster.normalize_dump accepts it unchanged. No peer_clock:
+        the service sits outside the p2p NTP graph, which routes its
+        merge through the raw-wall-anchor fallback by design."""
+        tracer = self._trace()
+        return {
+            "enabled": tracer.enabled,
+            "epoch_wall_ns": tracer.epoch_wall_ns,
+            "node_id": f"verify-service-{os.getpid()}",
+            "moniker": "verify-service",
+            "peer_clock": {},
+            "records": [r.to_json() for r in tracer.records()],
+        }
+
     # --- UDS protocol ------------------------------------------------------
 
     def _prune_client_stats(self) -> None:
@@ -490,20 +553,24 @@ class VerifyServiceServer:
                 typ, req_id = _HDR.unpack(cur.take(_HDR.size))
                 if typ == MSG_SUBMIT:
                     items, klass = decode_submit(cur)
+                    ctx = decode_trace_ctx(cur, req_id)
                     stats["submissions"] += 1
                     stats["rows"] += len(items)
                     # create_task here, synchronously in read order:
                     # tasks first run in creation order and submit()
                     # enqueues before its first await point, so one
                     # client's submissions keep FIFO within their class
-                    spawn(self._do_submit(send, req_id, items, klass))
+                    spawn(
+                        self._do_submit(send, req_id, items, klass, ctx)
+                    )
                 elif typ == MSG_SUBMIT_FN:
                     engine, items, klass = decode_submit_fn(cur)
+                    ctx = decode_trace_ctx(cur, req_id)
                     stats["fn_submissions"] += 1
                     stats["fn_items"] += len(items)
                     spawn(
                         self._do_submit_fn(
-                            send, req_id, engine, items, klass
+                            send, req_id, engine, items, klass, ctx
                         )
                     )
                 elif typ == MSG_PING:
@@ -540,26 +607,44 @@ class VerifyServiceServer:
                 self.client_stats.pop(client, None)
             writer.close()
 
-    async def _do_submit(self, send, req_id, items, klass) -> None:
+    def _service_span(self, ctx, t_recv: float, n: int, klass: str) -> None:
+        """End-to-end service-side span for one traced submission
+        (decode -> verdicts encoded); the queue/device slices inside it
+        are recorded by the scheduler under the same ctx."""
+        if ctx is None:
+            return
+        height, round_, origin, req = ctx
+        self._trace().add_span(
+            "verify.service", t_recv, time.perf_counter() - t_recv,
+            height=height, round=round_, origin=origin, req=req,
+            n=n, klass=klass,
+        )
+
+    async def _do_submit(self, send, req_id, items, klass, ctx=None):
+        t_recv = time.perf_counter()
         try:
-            verdicts = await self.scheduler.submit(items, klass)
+            verdicts = await self.scheduler.submit(items, klass, ctx=ctx)
         except Exception as e:
             await self._send_guarded(
                 send, encode_error(req_id, f"verify failed: {e!r}")
             )
             return
+        self._service_span(ctx, t_recv, len(items), klass)
         await self._send_guarded(send, encode_verdicts(req_id, verdicts))
 
-    async def _do_submit_fn(self, send, req_id, engine, items, klass):
+    async def _do_submit_fn(
+        self, send, req_id, engine, items, klass, ctx=None
+    ):
         fn = self.engines.get(engine)
         if fn is None:
             await self._send_guarded(
                 send, encode_error(req_id, f"unknown fn engine {engine!r}")
             )
             return
+        t_recv = time.perf_counter()
         try:
             results = await self.scheduler.submit_fn(
-                items, fn, klass, engine=engine
+                items, fn, klass, engine=engine, ctx=ctx
             )
         except Exception as e:
             await self._send_guarded(
@@ -567,6 +652,7 @@ class VerifyServiceServer:
                 encode_error(req_id, f"fn engine {engine} failed: {e!r}"),
             )
             return
+        self._service_span(ctx, t_recv, len(items), klass)
         await self._send_guarded(send, encode_fn_results(req_id, results))
 
     async def _send_guarded(self, send, payload: bytes) -> None:
@@ -601,6 +687,9 @@ class VerifyServiceServer:
             elif path == "/dump_dispatch_ledger":
                 body = json.dumps(self.dump()).encode()
                 status, ctype = 200, "application/json"
+            elif path == "/dump_traces":
+                body = json.dumps(self.trace_dump()).encode()
+                status, ctype = 200, "application/json"
             else:
                 body, status, ctype = b"not found\n", 404, "text/plain"
             reason = {200: "OK", 404: "Not Found",
@@ -620,15 +709,18 @@ class VerifyServiceServer:
 
 
 class _RemoteReq:
-    __slots__ = ("kind", "items", "klass", "future", "fallback", "t0")
+    __slots__ = (
+        "kind", "items", "klass", "future", "fallback", "t0", "ctx",
+    )
 
-    def __init__(self, kind, items, klass, future, fallback, t0):
+    def __init__(self, kind, items, klass, future, fallback, t0, ctx=None):
         self.kind = kind  # "sig" | "fn"
         self.items = items
         self.klass = klass
         self.future = future
         self.fallback = fallback  # zero-arg callable for the local path
         self.t0 = t0
+        self.ctx = ctx  # (height, round, origin, req_id) when traced
 
 
 class RemoteVerifyScheduler:
@@ -663,12 +755,17 @@ class RemoteVerifyScheduler:
         tracer=None,
         retry_base: float = 0.05,
         retry_cap: float = 2.0,
+        origin: str = "",
     ):
         self.path = path
         self._verifier = verifier
         self.logger = logger or nop_logger()
         self.metrics = metrics or default_metrics(RemoteSchedulerMetrics)
         self.tracer = tracer
+        # identity stamped into each submission's wire trace context so
+        # the service's queue/device sub-spans name their submitter
+        # (node assembly passes the node id; harnesses a worker label)
+        self.origin = origin or f"client-{os.getpid()}"
         self.retry_base = retry_base
         self.retry_cap = retry_cap
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -721,6 +818,11 @@ class RemoteVerifyScheduler:
     @property
     def ledger(self):
         return default_ledger()
+
+    def _trace(self):
+        # is-None check (Tracer defines __len__; `or` discards an
+        # injected-but-empty ring — the PR 4 falsy-tracer class)
+        return default_tracer() if self.tracer is None else self.tracer
 
     def ipc_stats(self) -> dict:
         """Cumulative client-side IPC counters (health pull seam)."""
@@ -836,16 +938,25 @@ class RemoteVerifyScheduler:
         self._rtt_count += 1
         self._rtt_sum += dt
         self.metrics.rtt_seconds.observe(dt)
+        if req.ctx is not None:
+            # the client-observed round trip, on the NODE's own ring
+            # and under the height it was stamped with: the per-height
+            # conservation audit bills this as verify_ipc, and the
+            # cluster merge joins it (via origin+req) to the service's
+            # queue/device sub-spans to expose the wire overhead
+            height, round_, origin, rid = req.ctx
+            self._trace().add_span(
+                "verify.ipc", req.t0, dt,
+                height=height, round=round_, origin=origin, req=rid,
+                n=len(req.items), klass=req.klass,
+            )
 
     # --- degradation -------------------------------------------------------
 
     def _degrade_event(self, reason: str, klass: str, n: int) -> None:
         self._degrades += 1
         self.metrics.degrades.inc()
-        # `or` would discard an injected-but-EMPTY tracer (Tracer has
-        # __len__ — the PR 4 falsy-tracer bug class)
-        tracer = default_tracer() if self.tracer is None else self.tracer
-        tracer.event(DEGRADE_EVENT, reason=reason, klass=klass, n=n)
+        self._trace().event(DEGRADE_EVENT, reason=reason, klass=klass, n=n)
 
     def _degrade_one(self, req: _RemoteReq, reason: str) -> None:
         """Resolve one request through its local path on the PRIVATE
@@ -928,18 +1039,29 @@ class RemoteVerifyScheduler:
         )
 
     async def _send_req(self, kind, items, klass, fallback, engine=""):
+        from ..obs.tracer import height_hint
+
         self._next_id += 1
         req_id = self._next_id
+        # trace context: the consensus height in progress (published by
+        # the state machine on every step transition) + this client's
+        # identity. Always stamped — ~15 bytes on the wire — so the
+        # service side can attribute even when the client's own ring is
+        # off; recording on either side stays gated on its tracer.
+        height, round_ = height_hint()
+        wire_ctx = (height, round_, self.origin)
         req = _RemoteReq(
             kind, items, klass, self._loop.create_future(), fallback,
-            time.perf_counter(),
+            time.perf_counter(), ctx=(height, round_, self.origin, req_id),
         )
         self._pending[req_id] = req
         try:
             payload = (
-                encode_submit(req_id, items, klass)
+                encode_submit(req_id, items, klass, ctx=wire_ctx)
                 if kind == "sig"
-                else encode_submit_fn(req_id, engine, items, klass)
+                else encode_submit_fn(
+                    req_id, engine, items, klass, ctx=wire_ctx
+                )
             )
             async with self._wlock:
                 writer = self._writer
@@ -1051,18 +1173,27 @@ def run_service(
     prewarm: bool = False,
     logger: Optional[Logger] = None,
     ready_fd: Optional[int] = None,
+    trace: bool = False,
 ) -> int:
     """Blocking service runtime for the CLI entrypoint: build the
     scheduler (which builds the process verifier/mesh on first
     dispatch), optionally AOT-prewarm the bucket ladder, serve until
     SIGINT/SIGTERM. `ready_fd` (harness use) gets one JSON line
     ({"ready": true, "stats_port": N}) written when the socket is
-    accepting — spawners wait on it instead of polling."""
+    accepting — spawners wait on it instead of polling. `trace` (or
+    TM_TPU_TRACE=1) arms the service flight ring served at
+    GET /dump_traces on the stats port."""
     import signal
 
+    from ..obs import Tracer, set_default_tracer
+
     logger = logger or nop_logger()
+    tracer = set_default_tracer(
+        Tracer(enabled=trace or os.environ.get("TM_TPU_TRACE") == "1")
+    )
     server = VerifyServiceServer(
-        path, max_batch=max_batch, logger=logger, stats_port=stats_port
+        path, max_batch=max_batch, logger=logger, stats_port=stats_port,
+        tracer=tracer,
     )
 
     async def run() -> None:

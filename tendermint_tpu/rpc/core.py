@@ -611,7 +611,13 @@ class RPCCore:
           `result.trace` to a file and load it in Perfetto."""
         from .. import obs
 
-        tracer = getattr(self.node, "tracer", None) or obs.default_tracer()
+        # is-None check: an empty Tracer is falsy (it defines __len__),
+        # so `or` would discard a node's injected-but-quiet ring and
+        # dump the (possibly unrelated) process default instead — the
+        # PR 4 falsy-tracer bug class
+        tracer = getattr(self.node, "tracer", None)
+        if tracer is None:
+            tracer = obs.default_tracer()
         records = tracer.records()
         if format == "chrome":
             return {
@@ -646,7 +652,21 @@ class RPCCore:
                 for h, rows in obs.flight_snapshot(records, n).items()
             },
             "attribution": obs.attribution(recs),
+            # the per-height conservation audit: named buckets + the
+            # dark_time residue the health plane alarms on
+            "conservation": self._conservation_json(recs, n),
         }
+
+    @staticmethod
+    def _conservation_json(recs: list, n: int) -> dict:
+        from .. import obs
+
+        cons = obs.wall_conservation(recs, n)
+        # string height keys like the flight view (JSON object keys)
+        cons["heights"] = {
+            str(h): row for h, row in cons["heights"].items()
+        }
+        return cons
 
     def _peer_clock(self) -> dict:
         sw = getattr(self.node, "switch", None)

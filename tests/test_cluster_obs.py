@@ -214,7 +214,7 @@ def test_merge_records_rebases_onto_reference_timeline():
 
 REPORT_KEYS = {
     "schema", "reference", "nodes", "offsets", "heights", "links",
-    "stragglers",
+    "stragglers", "verify_flow",
 }
 NODE_KEYS = {"name", "node_id", "records"}
 OFFSET_KEYS = {"offset_s", "rtt_s", "hops", "source"}
@@ -272,7 +272,7 @@ def test_merge_dedupes_duplicate_monikers():
 def test_cluster_report_schema_golden():
     report = obs.cluster_report(_synthetic_dumps())
     assert set(report) == REPORT_KEYS
-    assert report["schema"] == "tm-tpu/cluster-report/v1"
+    assert report["schema"] == "tm-tpu/cluster-report/v2"
     assert report["reference"] == "A"
     assert [set(n) for n in report["nodes"]] == [NODE_KEYS, NODE_KEYS]
     assert all(set(o) == OFFSET_KEYS for o in report["offsets"].values())

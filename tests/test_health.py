@@ -861,7 +861,7 @@ def test_trend_backend_partition_and_gate_math(tmp_path):
         art("cpu_a", "ed25519_vote_verify_throughput", 2300.0, "cpu", 4),
         art("cpu_b", "ed25519_vote_verify_throughput", 2250.0, "cpu", 6),
     ]
-    rows, skipped = bt.ingest(files)
+    rows, skipped, _ = bt.ingest(files)
     assert not skipped and len(rows) == 4
     groups = bt.build_groups(rows)
     # rows partition by backend: the 2.3k CPU rows NEVER compare
@@ -882,7 +882,7 @@ def test_trend_backend_partition_and_gate_math(tmp_path):
     files.append(
         art("cpu_c", "ed25519_vote_verify_throughput", 1840.0, "cpu", 7)
     )
-    rows, _ = bt.ingest(files)
+    rows, _, _ = bt.ingest(files)
     failures, _ = bt.check_gate(bt.build_groups(rows), threshold=0.15)
     assert len(failures) == 1
     assert failures[0]["backend"] == "cpu"
@@ -913,7 +913,7 @@ def test_trend_backend_partition_and_gate_math(tmp_path):
             ],
         ),
     ]
-    rows, _ = bt.ingest(files)
+    rows, _, _ = bt.ingest(files)
     failures, warnings = bt.check_gate(bt.build_groups(rows), 0.15)
     assert not failures and len(warnings) == 1
     failures, warnings = bt.check_gate(
@@ -941,7 +941,7 @@ def test_trend_ingest_normalizes_historical_shapes(tmp_path):
     # unreadable artifact: a skip, not a crash
     broken = tmp_path / "BENCH_r92.json"
     broken.write_text("{not json")
-    rows, skipped = bt.ingest([str(wrapped), str(failed), str(broken)])
+    rows, skipped, _ = bt.ingest([str(wrapped), str(failed), str(broken)])
     assert len(rows) == 1
     assert rows[0]["backend"] == "tpu"  # inferred from the tail
     assert rows[0]["round"] == 90

@@ -1081,7 +1081,7 @@ def test_bench_trend_ingests_verify_service_family(tmp_path):
     p2 = tmp_path / "BENCH_r91.json"
     p1.write_text(json.dumps(artifact(90, 1000.0)))
     p2.write_text(json.dumps(artifact(91, 1300.0)))  # 30% worse
-    rows, skipped = ingest([str(p1), str(p2)])
+    rows, skipped, _ = ingest([str(p1), str(p2)])
     assert not skipped
     groups = build_groups(rows)
     head = next(

@@ -30,6 +30,14 @@ tool makes the trajectory first-class:
   (e.g. ed25519_commit10k_latency r05→r06: +26% on an unrelated-PR
   rerun), and a gate that cries wolf gets deleted.
 
+- **Conservation** (PR 15): artifacts carrying a `wall_conservation`
+  block are schema-validated — buckets must sum to the measured wall
+  per height (obs.report.check_conservation) or the artifact's rows
+  are rejected outright — and `--check` additionally fails when the
+  LATEST artifact's aggregate dark_time fraction exceeds
+  `--dark-threshold` (0.05 default): wall time with no instrumented
+  owner is a regression in the attribution plane itself.
+
 - **Render**: TREND.md (per-family tables: best/latest/delta with the
   round each came from) + machine-readable TREND.json.
 
@@ -50,6 +58,12 @@ import re
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# stdlib-only import (obs/ carries no deps): the one conservation-check
+# implementation bench.py stamps with and this gate validates with —
+# a local copy would drift from the bucket list
+from tendermint_tpu.obs.report import check_conservation  # noqa: E402
 
 # --- metric classification --------------------------------------------------
 
@@ -255,10 +269,43 @@ def _metric_rows(payload: dict) -> list[tuple[dict, bool]]:
     return rows
 
 
-def ingest(paths: list[str]) -> tuple[list[dict], list[dict]]:
-    """Normalize artifacts into (rows, skipped)."""
+def _conservation_of(payload: dict, name: str):
+    """(dark_row, violation) from a payload's `wall_conservation`
+    block (PR 15). Artifacts without the block — everything before
+    r14 — return (None, None): the audit is only enforced where the
+    bench claimed to have run it. A block whose buckets do NOT sum to
+    the measured wall is a schema violation: the artifact's rows are
+    rejected outright (a row whose own attribution doesn't reconcile
+    cannot be trusted as a measurement)."""
+    block = payload.get("wall_conservation")
+    if block is None:
+        return None, None
+    errs = check_conservation(block)
+    if errs:
+        return None, f"conservation violation: {'; '.join(errs[:3])}"
+    agg = (block.get("aggregate") or {}) if isinstance(block, dict) else {}
+    if not agg:
+        return None, None
+    return (
+        {
+            "file": name,
+            "dark_fraction": float(agg.get("dark_fraction", 0.0)),
+            "dark_fraction_max": float(
+                agg.get("dark_fraction_max", 0.0)
+            ),
+            "n_heights": int(agg.get("n_heights", 0)),
+        },
+        None,
+    )
+
+
+def ingest(
+    paths: list[str],
+) -> tuple[list[dict], list[dict], list[dict]]:
+    """Normalize artifacts into (rows, skipped, conservation)."""
     rows: list[dict] = []
     skipped: list[dict] = []
+    conservation: list[dict] = []
     for i, path in enumerate(paths):
         name = os.path.basename(path)
         rnd = _round_of(path, fallback=1000 + i)
@@ -295,6 +342,13 @@ def ingest(paths: list[str]) -> tuple[list[dict], list[dict]]:
                 }
             )
             continue
+        dark_row, violation = _conservation_of(payload, name)
+        if violation:
+            skipped.append({"file": name, "reason": violation})
+            continue
+        if dark_row is not None:
+            dark_row["round"] = rnd
+            conservation.append(dark_row)
         pairs = _metric_rows(payload)
         if not pairs:
             skipped.append(
@@ -332,7 +386,7 @@ def ingest(paths: list[str]) -> tuple[list[dict], list[dict]]:
                     "headline": headline,
                 }
             )
-    return rows, skipped
+    return rows, skipped, conservation
 
 
 # --- trajectory + gate ------------------------------------------------------
@@ -401,6 +455,23 @@ def check_gate(
         elif g["family"] in TIER1_FAMILIES:
             (failures if strict else warnings).append(g)
     return failures, warnings
+
+
+def check_dark(
+    conservation: list[dict], threshold: float
+) -> list[dict]:
+    """Absolute dark-time gate: the LATEST round carrying a
+    conservation block must keep its aggregate dark fraction under
+    `threshold` — wall time with no instrumented owner is a regression
+    in the attribution plane itself, regardless of how fast the run
+    was. (Not a vs-best comparison: dark near zero is the steady state,
+    and judging noise around zero in relative terms would cry wolf.)"""
+    if not conservation:
+        return []
+    latest = max(conservation, key=lambda c: c["round"])
+    if latest["dark_fraction"] > threshold:
+        return [dict(latest, threshold=threshold)]
+    return []
 
 
 # --- rendering --------------------------------------------------------------
@@ -510,6 +581,14 @@ def main() -> int:
         help="--check also fails on extra-metric regressions",
     )
     ap.add_argument(
+        "--dark-threshold",
+        type=float,
+        default=0.05,
+        help="max aggregate dark_time fraction the latest artifact's "
+        "wall_conservation block may carry under --check "
+        "(default 0.05)",
+    )
+    ap.add_argument(
         "--write",
         action="store_true",
         help="write TREND.md + TREND.json into --dir",
@@ -528,11 +607,12 @@ def main() -> int:
         print("no artifacts found", file=sys.stderr)
         return 2
 
-    rows, skipped = ingest(files)
+    rows, skipped, conservation = ingest(files)
     groups = build_groups(rows)
     failures, warnings = check_gate(
         groups, args.threshold, strict=args.strict
     )
+    dark_failures = check_dark(conservation, args.dark_threshold)
     doc = {
         "schema": "tm-tpu/bench-trend/v1",
         "threshold": args.threshold,
@@ -540,10 +620,16 @@ def main() -> int:
         "rows": rows,
         "groups": groups,
         "skipped": skipped,
+        "conservation": {
+            "dark_threshold": args.dark_threshold,
+            "blocks": conservation,
+            "failures": dark_failures,
+        },
         "check": {
             "failures": failures,
             "warnings": warnings,
-            "ok": not failures,
+            "dark_failures": dark_failures,
+            "ok": not failures and not dark_failures,
         },
     }
     md = render_md(groups, skipped, files, args.threshold)
@@ -572,6 +658,19 @@ def main() -> int:
             file=sys.stderr,
         )
     if args.check:
+        if dark_failures:
+            for d in dark_failures:
+                print(
+                    f"# FAIL dark-time gate: {d['file']} "
+                    f"dark_fraction {d['dark_fraction']:.3f} > "
+                    f"{args.dark_threshold:.3f} over {d['n_heights']} "
+                    f"heights (worst height "
+                    f"{d['dark_fraction_max']:.3f}) — wall time with "
+                    "no instrumented owner",
+                    file=sys.stderr,
+                )
+            if not failures:
+                return 1
         if failures:
             for g in failures:
                 print(

@@ -14,6 +14,14 @@ the controller LEARNED from the live arrival tail vs the static config
 schedule, and where its AIMD back-off level sits — the before/after of
 the adaptive-pacing loop in one table.
 
+For the consensus family the report also renders the wall-clock
+CONSERVATION audit (obs.report.wall_conservation): every height's wall
+decomposed into mutually-exclusive named buckets — floor / gossip /
+compute plus the carved verify IPC/queue/device, WAL fsync and commit
+pipeline slices — with the unowned residue called out as `dark_time`
+instead of folded into `other`. This is the ground truth the ROADMAP
+item-4 controller work consumes.
+
 Usage:
     python tools/pacing_report.py dump.json [dump2.json ...] [--json]
     curl -s localhost:26657/dump_traces | python tools/pacing_report.py -
@@ -30,8 +38,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tendermint_tpu.obs import (
     FAMILY_WALL_SPANS,
+    conservation_table,
     pacing_decisions,
     wall_attribution,
+    wall_conservation,
 )
 from tools.trace_report import extract_records
 
@@ -46,10 +56,17 @@ def _load(path: str):
 def report(
     records: list[dict], n_heights: int = 64, family: str = "consensus"
 ) -> dict:
-    return {
+    out = {
         "wall": wall_attribution(records, n_heights, family=family),
         "pacing": pacing_decisions(records),
     }
+    if family == "consensus":
+        # the exhaustive bucket audit rides the cs.* step spans, so it
+        # only applies to the consensus-classified families — item 4's
+        # controller work reads the verify/WAL/pipeline buckets (and
+        # the dark residue) from here
+        out["conservation"] = wall_conservation(records, n_heights)
+    return out
 
 
 def report_text(rep: dict, name: str = "") -> str:
@@ -83,6 +100,9 @@ def report_text(rep: dict, name: str = "") -> str:
             f"{v['gossip_ms']:>9.2f} {v['compute_ms']:>10.2f} "
             f"{v['other_ms']:>9.2f}"
         )
+    cons = rep.get("conservation")
+    if cons is not None:
+        lines.append(conservation_table(cons))
     pacing = rep["pacing"]
     if pacing:
         lines.append("pacing decisions (learned vs static)")
