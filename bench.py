@@ -276,6 +276,7 @@ def main() -> None:
         default="",
         choices=(
             "",
+            "consensus_pipeline",
             "consensus_pacing",
             "lightserve",
             "committee_scale",
@@ -381,6 +382,11 @@ def main() -> None:
         # the verify path rides the host fast lane either way and both
         # variants pay it identically
         print(json.dumps(_bench_consensus_pacing()))
+        return
+    if args.family == "consensus_pipeline":
+        # wall-clock family, same CPU-validity argument as pacing: both
+        # variants share one verify path; the DELTA is the overlap
+        print(json.dumps(_bench_consensus_pipeline()))
         return
     if args.family == "lightserve":
         print(json.dumps(_bench_lightserve(n_clients=args.clients)))
@@ -789,6 +795,147 @@ def _bench_consensus_pacing(heights: int = 10, warm: int = 4) -> dict:
                 "metric": "consensus_pacing_commit_wait_adaptive",
                 "value": commit_eff,
                 "unit": "ms effective commit wait (static 1000)",
+            },
+        ],
+    }
+
+
+def _bench_consensus_pipeline(heights: int = 12, warm: int = 4) -> dict:
+    """consensus_pipeline family (PERF_ANALYSIS §22): effective
+    wall-per-height on the 4-validator in-proc net with QC-chained
+    height pipelining — enter H+1's propose when H's precommit quorum
+    closes, chain H's apply/save/fsync behind the durability barrier in
+    the background — against the identical adaptive-pacing config run
+    serially. Wall-clock family: both variants share one verify path
+    and one host crypto plane; the DELTA is the overlap.
+
+    The conservation block comes from the PIPELINED variant: buckets
+    exceed the wall exactly by the booked pipeline_overlap_ms (height
+    H's background finalization attributed under H while H+1's steps
+    own the shared wall), dark_time stays 0 — the decomposition remains
+    exhaustive under overlap (obs.report.wall_conservation)."""
+    import asyncio
+
+    from tendermint_tpu import obs
+    from tendermint_tpu.consensus.state_machine import ConsensusConfig
+    from tests.helpers import make_genesis, make_validators
+    from tests.test_consensus import make_node, wire_net
+
+    def run_variant(pipelined: bool) -> dict:
+        cfg = ConsensusConfig(
+            # the consensus_pacing adaptive config, unchanged — r14's
+            # 454.8 ms/height baseline is this exact schedule serial
+            timeout_propose=3.0,
+            timeout_propose_delta=0.5,
+            timeout_prevote=1.0,
+            timeout_prevote_delta=0.5,
+            timeout_precommit=1.0,
+            timeout_precommit_delta=0.5,
+            timeout_commit=1.0,
+            skip_timeout_commit=False,
+            adaptive_timeouts=True,
+            adaptive_window=64,
+            adaptive_min_samples=4,
+            adaptive_recover_step=0.25,
+            adaptive_tail_quantile=0.95,
+            adaptive_min_factor=0.02,
+            pipelined_heights=pipelined,
+        )
+        tracer = obs.Tracer(enabled=True, ring_size=65536)
+
+        async def run():
+            vs, pvs = make_validators(4)
+            genesis = make_genesis(vs)
+            nodes = [
+                make_node(
+                    vs,
+                    pv,
+                    genesis,
+                    config=cfg,
+                    tracer=(
+                        tracer if i == 0 else obs.Tracer(enabled=False)
+                    ),
+                )
+                for i, pv in enumerate(pvs)
+            ]
+            css = [n[0] for n in nodes]
+            wire_net(css)
+            for cs in css:
+                await cs.start()
+            await asyncio.gather(
+                *(cs.wait_for_height(warm, timeout=120) for cs in css)
+            )
+            tracer.clear()
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(
+                    cs.wait_for_height(warm + heights, timeout=600)
+                    for cs in css
+                )
+            )
+            wall = (time.perf_counter() - t0) / heights
+            app_hashes = {cs.state.app_hash for cs in css}
+            for cs in css:
+                await cs.stop()
+            assert len(app_hashes) == 1, "variant diverged"
+            return wall
+
+        wall = asyncio.run(run())
+        recs = [r.to_json() for r in tracer.records()]
+        return {
+            "wall_ms": round(wall * 1e3, 1),
+            "conservation": obs.wall_conservation(recs),
+        }
+
+    ledger_mark = _ledger_mark()
+    serial = run_variant(False)
+    piped = run_variant(True)
+    agg = piped["conservation"].get("aggregate", {})
+    return {
+        "metric": "consensus_pipeline_wall_per_height",
+        "value": piped["wall_ms"],
+        "unit": (
+            f"ms effective/height pipelined (serial "
+            f"{serial['wall_ms']} ms same run+config; 4 validators, "
+            f"in-proc, wall-clock)"
+        ),
+        "vs_baseline": round(
+            serial["wall_ms"] / max(piped["wall_ms"], 0.01), 2
+        ),
+        "meta": _meta_block(),
+        "device_cost": _device_cost_block(ledger_mark),
+        "wall_conservation": piped["conservation"],
+        "extra_metrics": [
+            {
+                "metric": "consensus_pipeline_serial_wall_per_height",
+                "value": serial["wall_ms"],
+                "unit": "ms/height, same adaptive config, no overlap",
+            },
+            {
+                "metric": "consensus_pipeline_overlap_share",
+                "value": agg.get("pipeline_overlap_share"),
+                "unit": (
+                    "booked background-finalization overlap as a "
+                    "fraction of pipelined wall"
+                ),
+            },
+            {
+                "metric": "consensus_pipeline_floor_share",
+                "value": agg.get("floor_share"),
+                "unit": "fraction of pipelined wall in timeout floors",
+            },
+            {
+                "metric": "consensus_pipeline_commit_pipeline_share",
+                "value": agg.get("commit_pipeline_share"),
+                "unit": (
+                    "apply/save/QC-assembly share of pipelined wall "
+                    "(mostly overlap-credited)"
+                ),
+            },
+            {
+                "metric": "consensus_pipeline_dark_fraction",
+                "value": agg.get("dark_fraction"),
+                "unit": "unattributed share of pipelined wall",
             },
         ],
     }

@@ -29,6 +29,11 @@ class ValidatorUpdate:
     pub_key_type: str
     pub_key_data: bytes
     power: int
+    # morph QC plane: rotating a validator in with its BLS12-381 G2 key
+    # (192 bytes uncompressed) makes it QC-capable from its first height
+    # in the set; empty means "no key supplied" — an update to an
+    # existing member keeps the key already on record
+    bls_pub_key: bytes = b""
 
 
 @dataclass

@@ -175,6 +175,14 @@ class ConsensusTimeoutsConfig:
     # (bls_pub_key); legacy peers interoperate — they ignore the QC and
     # keep verifying the full commit.
     quorum_certificates: bool = False
+    # --- QC-chained height pipelining (consensus/state_machine.py) --------
+    # enter H+1's propose on H's quorum close instead of waiting out
+    # timeout_commit, chain the QC assembly and the end-height fsync
+    # behind the commit, and buffer one height of early peer traffic.
+    # Non-pipelined peers keep following the chain (gossip catchup
+    # serves them); a pipelined node restarted mid-boundary replays
+    # without double-sign or height skip (tests/test_pipeline.py).
+    pipelined_heights: bool = False
     # --- committee-scale vote gossip (consensus/reactor.py) ---------------
     # ship all votes a peer is missing per gossip tick in bounded
     # VoteBatchMessage chunks (peers negotiate via the advertised
@@ -214,6 +222,7 @@ class ConsensusTimeoutsConfig:
         "adaptive_backoff_step",
         "adaptive_recover_step",
         "quorum_certificates",
+        "pipelined_heights",
     )
 
     def validate_basic(self) -> None:

@@ -73,25 +73,38 @@ class CommitPipeline:
     # --- producer side (the state machine's finalize) -----------------------
 
     def begin(
-        self, height: int, apply_fn: Callable[[], Awaitable]
+        self,
+        height: int,
+        apply_fn: Callable[[], Awaitable],
+        barrier: Optional[Callable[[], Awaitable]] = None,
     ) -> asyncio.Task:
         """Spawn the background finalization task for `height`. The
         caller must have awaited `wait_applied()` first, so at most one
-        task is ever in flight."""
+        task is ever in flight.
+
+        `barrier` (QC-chained height pipelining, PERF_ANALYSIS §22)
+        chains the apply behind a durability boundary: it is awaited
+        BEFORE apply_fn, so nothing this task persists can outrun the
+        height's decision record — while the state machine, which no
+        longer waits for that fsync inline, is already proposing H+1. A
+        barrier failure latches the pipeline error exactly like a failed
+        apply: un-durable decisions must wedge, not apply."""
         if self._task is not None and not self._task.done():
             raise RuntimeError(
                 f"finalization for height {self._height} still in flight"
             )
         self._height = height
         self._task = asyncio.get_running_loop().create_task(
-            self._run(height, apply_fn),
+            self._run(height, apply_fn, barrier),
             name=f"consensus/finalize-{height}",
         )
         return self._task
 
-    async def _run(self, height: int, apply_fn):
+    async def _run(self, height: int, apply_fn, barrier=None):
         gauge = getattr(self.metrics, "commit_pipeline_depth", None)
         try:
+            if barrier is not None:
+                await barrier()
             if gauge is not None:
                 with gauge.track_inprogress():
                     out = await apply_fn()

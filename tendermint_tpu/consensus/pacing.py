@@ -55,11 +55,20 @@ derive identical schedules (tested).
 
 from __future__ import annotations
 
+import json
+import os
 from dataclasses import dataclass
 from typing import Optional
 
 from ..obs.quantile import StreamingQuantile
 from ..types.vote import VoteType
+
+# persisted pacing-tail schema (save_tails/load_tails): the learned
+# arrival-tail windows + per-step AIMD back-off, written next to the WAL
+# so a restarting validator re-enters the committee with the tails it
+# had already learned instead of paying min_samples heights of static
+# schedules per step
+PACING_STATE_SCHEMA = "tm-tpu/pacing-tails/v1"
 
 # step kinds, in schedule order; these are the `step=` label values of
 # consensus_adaptive_timeout_seconds and the pacing.decision trace events
@@ -243,6 +252,9 @@ class PacingController:
                 STEP_COMMIT, static_config.timeout_commit, self.cfg
             ),
         }
+        # persistence target (node assembly points this next to the WAL
+        # file; None = in-memory only, the harness default)
+        self.persist_path: Optional[str] = None
         # fired-timeout tallies (ticker wiring; staleness-unfiltered).
         # Only the steps that CAN fire as failures: the commit wait's
         # NEW_HEIGHT expiry fires every healthy height by design, so a
@@ -388,6 +400,94 @@ class PacingController:
         teach the controller a committee that doesn't exist."""
         for ctl in self._steps.values():
             ctl.sketch.reset()
+
+    # --- persistence (learned-tail warm starts) ---------------------------
+
+    def state_dict(self) -> dict:
+        """The restorable learning state: per step, the windowed lag
+        samples (arrival order), lifetime count, and back-off level.
+        Static values ride along as a sanity cross-check only — lags
+        are properties of the committee, not of the configured ceiling,
+        so a config change does not invalidate them."""
+        return {
+            "schema": PACING_STATE_SCHEMA,
+            "steps": {
+                name: {
+                    "static_s": ctl.static_s,
+                    "backoff": round(ctl.backoff, 6),
+                    "count": ctl.sketch.count,
+                    "samples": [
+                        round(x, 6) for x in ctl.sketch.to_list()
+                    ],
+                }
+                for name, ctl in self._steps.items()
+            },
+        }
+
+    def load_state(self, blob) -> bool:
+        """Restore a state_dict. Tolerant by design — a missing step,
+        wrong schema, or junk shape loads nothing (False) rather than
+        poisoning a running controller: the worst outcome of a bad
+        tails file must be 'start static', never 'start wrong'."""
+        if (
+            not isinstance(blob, dict)
+            or blob.get("schema") != PACING_STATE_SCHEMA
+            or not isinstance(blob.get("steps"), dict)
+        ):
+            return False
+        loaded = False
+        for name, ctl in self._steps.items():
+            row = blob["steps"].get(name)
+            if not isinstance(row, dict):
+                continue
+            samples = row.get("samples")
+            if not isinstance(samples, list):
+                continue
+            try:
+                ctl.sketch.load(
+                    (float(x) for x in samples),
+                    int(row.get("count", 0)),
+                )
+            except (TypeError, ValueError):
+                ctl.sketch.reset()
+                continue
+            b = row.get("backoff")
+            if isinstance(b, (int, float)):
+                ctl.backoff = min(1.0, max(0.0, float(b)))
+            loaded = True
+        return loaded
+
+    def save_tails(self, path: Optional[str] = None) -> bool:
+        """Atomically persist the learning state to `path` (default:
+        persist_path). Write-to-temp + rename so a crash mid-save
+        leaves the previous file intact. False when unconfigured or
+        the write fails — persistence is best-effort, never fatal."""
+        path = path or self.persist_path
+        if not path:
+            return False
+        try:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self.state_dict(), f)
+            os.replace(tmp, path)
+            return True
+        except OSError:
+            return False
+
+    def load_tails(self, path: Optional[str] = None) -> bool:
+        """Reload persisted tails (default path: persist_path). Called
+        AFTER WAL catchup replay's reset_learning so the warm start —
+        tails learned live before the restart — survives while the
+        replay-speed contamination does not."""
+        path = path or self.persist_path
+        if not path:
+            return False
+        try:
+            with open(path, encoding="utf-8") as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            return False
+        return self.load_state(blob)
 
     # --- introspection ----------------------------------------------------
 

@@ -28,7 +28,22 @@ from .wal import KIND_END_HEIGHT, WAL
 async def catchup_replay(cs, wal: WAL) -> int:
     """Re-process WAL messages logged after the last committed height
     (reference catchupReplay). Returns the number of messages replayed.
-    Must run before the receive routine starts."""
+    Must run before the receive routine starts.
+
+    Pipelined-heights boundary semantics: peers running one height
+    ahead interleave H+1 traffic into the WAL BEFORE end_height(H), so
+    a replayed message stream can contain future-height messages — the
+    state machine's next-height buffer holds them exactly as it would
+    live ones, and they drain when the replayed quorum closes H. Our
+    OWN H+1 messages can never precede end_height(H) in the file: they
+    are only created after the height transition, which happens after
+    the end-height record was written, and the group-commit WAL
+    preserves write order — that ordering (plus the background
+    finalization task refusing to persist state before its end-height
+    barrier, CommitPipeline.begin) is what makes a crash between H+1's
+    propose and H's durable decision replay without double-sign or
+    height skip. Peer H+1 messages lost with a torn tail re-arrive via
+    gossip catchup."""
     committed = cs.state.last_block_height
     msgs = wal.search_for_end_height(committed)
     if msgs is None:

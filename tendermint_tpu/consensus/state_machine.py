@@ -110,6 +110,16 @@ class ConsensusConfig:
     # the full commit, and verify LastCommits via ONE pairing check.
     # Requires a qc-capable validator set (every member has a BLS key).
     quorum_certificates: bool = False
+    # --- QC-chained height pipelining (PERF_ANALYSIS §22) ------------------
+    # Enter H+1's propose the moment H's precommit quorum closes instead
+    # of waiting out the straggler window: the closed quorum (and, with
+    # quorum_certificates on, the QC the commit chain aggregates from it
+    # in the background) IS H+1's justification. Messages from peers
+    # already one height ahead are held in a bounded buffer and re-fed on
+    # our own height transition, and the end-height fsync rides the
+    # background finalization task (ordering, not placement, is what the
+    # replay invariant needs — see _finalize_commit).
+    pipelined_heights: bool = False
 
     def propose(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_
@@ -222,6 +232,19 @@ class ConsensusState:
         self.tracer = default_tracer() if tracer is None else tracer
         self.logger = logger or nop_logger()
         self.now_ns = now_ns
+        # pipelined heights need a commit pipeline to overlap into; as
+        # with pacing below, an explicit one wins (node assembly wires
+        # it with the group WAL + write-behind store), otherwise
+        # self-construct so in-proc harnesses get the overlap from
+        # `pipelined_heights` alone
+        if self.pipeline is None and config.pipelined_heights:
+            from .commit_pipeline import CommitPipeline
+
+            self.pipeline = CommitPipeline(
+                metrics=self.metrics,
+                tracer=self.tracer,
+                logger=self.logger,
+            )
         # adaptive pacing: an explicit controller wins (node assembly
         # injects one); otherwise self-construct from the config so the
         # in-proc harnesses get it from `adaptive_timeouts` alone
@@ -254,6 +277,20 @@ class ConsensusState:
         # validator indices whose too-late straggler precommit already
         # fed the commit sketch this height (gossip re-delivers)
         self._late_stragglers_fed: set[int] = set()
+        # pipelined heights: messages for rs.height + 1 arriving while
+        # this node is still closing rs.height (peers enter H+1 on the
+        # quorum close, which races our finalize) — held and re-fed
+        # through _handle_msg on our own height transition; neither the
+        # in-proc harness nor a quiet gossip link re-sends, so dropping
+        # them (the non-pipelined behavior) would wedge the follower
+        self._next_height_buf: list[tuple] = []
+        # reentrancy guard: a drained message can finalize the height
+        # and re-enter the drain from inside _finalize_commit
+        self._draining_next_height = False
+        # (height, task) of the QC assembly chained behind that height's
+        # commit — the H+1 proposer awaits the chained result instead of
+        # paying the aggregate + pairing check on its propose path
+        self._qc_chain: Optional[tuple[int, asyncio.Task]] = None
 
         self.event_switch = EventSwitch()
 
@@ -320,6 +357,13 @@ class ConsensusState:
                     # replayed votes arrived at replay speed — their
                     # near-zero lags are not the live committee's tail
                     self.pacing.reset_learning()
+        # warm-start the pacing tails persisted next to the WAL — after
+        # the replay reset, so the pre-restart live tails win over both
+        # the empty sketches and any replay contamination
+        if self.pacing is not None and self.pacing.load_tails():
+            self.logger.info(
+                "pacing tails restored", path=self.pacing.persist_path
+            )
         self._running = True
         self._receive_task = asyncio.get_running_loop().create_task(
             self._receive_routine(), name="consensus/receive"
@@ -329,6 +373,20 @@ class ConsensusState:
     async def stop(self) -> None:
         self._running = False
         self.ticker.stop()
+        if self.pacing is not None:
+            # persist the learned tails (no-op without a persist_path)
+            # so the next start warm-starts instead of re-learning
+            self.pacing.save_tails()
+        if self._qc_chain is not None:
+            # an unconsumed chained QC assembly (we stopped before
+            # proposing the next height) must not outlive the loop
+            _, task = self._qc_chain
+            self._qc_chain = None
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._receive_task:
             self._receive_task.cancel()
             try:
@@ -472,7 +530,61 @@ class ConsensusState:
         else:
             self.wal.write(WALMessage(kind, data))
 
+    # hard cap on the next-height holding buffer: a full height of
+    # committee traffic is far below this, and a byzantine flood of
+    # future-height messages must not grow memory without bound
+    _NEXT_HEIGHT_BUF_CAP = 4096
+
+    def _buffer_next_height_msg(self, msg, peer_id: str) -> None:
+        if len(self._next_height_buf) >= self._NEXT_HEIGHT_BUF_CAP:
+            self.logger.error(
+                "next-height buffer full; dropping",
+                kind=type(msg).__name__,
+                peer=peer_id,
+            )
+            return
+        self._next_height_buf.append((msg, peer_id))
+
+    async def _drain_next_height_buf(self) -> None:
+        """Re-feed held H+1 messages once rs.height reaches them. A
+        drained message can itself close the new height's quorum and
+        finalize (re-entering here from _finalize_commit with the
+        following height's messages re-stashed): the guard collapses the
+        recursion and the outer loop picks the re-stash up."""
+        if self._draining_next_height or not self._next_height_buf:
+            return
+        self._draining_next_height = True
+        try:
+            progressed = True
+            while progressed and self._next_height_buf:
+                progressed = False
+                pending = self._next_height_buf
+                self._next_height_buf = []
+                for msg, peer_id in pending:
+                    h = _msg_height(msg)
+                    if h is not None and h < self.rs.height:
+                        continue  # already decided; gossip catchup serves it
+                    if h is not None and h > self.rs.height:
+                        self._buffer_next_height_msg(msg, peer_id)
+                        continue
+                    progressed = True
+                    try:
+                        await self._handle_msg(msg, peer_id)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        self.logger.error(
+                            "buffered next-height msg failed", err=repr(e)
+                        )
+        finally:
+            self._draining_next_height = False
+
     async def _handle_msg(self, msg, peer_id: str) -> None:
+        if self.config.pipelined_heights:
+            h = _msg_height(msg)
+            if h is not None and h == self.rs.height + 1:
+                self._buffer_next_height_msg(msg, peer_id)
+                return
         if isinstance(msg, ProposalMessage):
             self._set_proposal(msg.proposal)
         elif isinstance(msg, BlockPartMessage):
@@ -738,17 +850,24 @@ class ConsensusState:
             and last_commit is not None
             and self.state.last_validators.qc_capable()
         ):
-            from ..types.quorum_cert import assemble_qc
+            # pipelined heights hand the proposer an already-assembled
+            # certificate (chained behind H-1's commit, _maybe_chain_qc);
+            # the on-demand path below is the fallback for round > 0
+            # re-proposals, restarts, and non-pipelined configs
+            qc = await self._take_chained_qc(height - 1)
+            if qc is None:
+                from ..types.quorum_cert import assemble_qc
 
-            block.last_qc = await (
-                asyncio.get_running_loop().run_in_executor(
-                    None,
-                    assemble_qc,
-                    self.state.chain_id,
-                    last_commit,
-                    self.state.last_validators,
+                qc = await (
+                    asyncio.get_running_loop().run_in_executor(
+                        None,
+                        assemble_qc,
+                        self.state.chain_id,
+                        last_commit,
+                        self.state.last_validators,
+                    )
                 )
-            )
+            block.last_qc = qc
         # decideBatchPoint (reference :1318-1362): seal when the L2 says
         # size is exceeded OR the on-chain Batch params' blocks_interval /
         # timeout elapsed since the batch start (which survives restarts
@@ -1157,6 +1276,7 @@ class ConsensusState:
         fail.fail_point()
         t_commit = time.perf_counter()
         # save block + seen commit (enqueue-only on the write-behind store)
+        seen_commit = None
         if self.block_store.height < height:
             seen_commit = precommits.make_commit()
             with self.tracer.span(
@@ -1170,10 +1290,25 @@ class ConsensusState:
                         time.perf_counter() - t_save
                     )
         fail.fail_point()
-        # WAL barrier: after this record, the height is decided
+        # WAL barrier: after this record, the height is decided.
+        # Pipelined heights move the WAIT for the fsync off the decision
+        # path onto the background finalization task (before anything
+        # durable happens there): what replay needs is the ORDER — state
+        # may only advance to H after end_height(H) is durable, and our
+        # own H+1 messages are only acted on after the receive routine's
+        # batch barrier, which (group commit preserves file order)
+        # covers this record too. The fsync itself overlaps H+1's
+        # propose instead of serializing ahead of it.
+        wal_mark: Optional[int] = None
+        pipelining = (
+            self.config.pipelined_heights and self.pipeline is not None
+        )
         if self.pipeline is not None:
             self.wal.write(end_height_record(height))
-            await self.wal.abarrier()
+            if pipelining:
+                wal_mark = self.wal.mark()
+            else:
+                await self.wal.abarrier()
         else:
             self.wal.write_end_height(height)
         fail.fail_point()
@@ -1221,17 +1356,38 @@ class ConsensusState:
             # the exec.apply_block span and pipeline_wait.
             self.batch_cache.on_block_committed(block)
             self._record_committed(t_commit, block, parts, pipelined=True)
+            barrier = None
+            if wal_mark is not None:
+                # the end-height fsync the decision path stopped waiting
+                # for: the background task waits instead, BEFORE apply
+                # persists anything (state save outrunning this barrier
+                # would leave a crash image whose state has no WAL
+                # end-height record — the fatal replay case). The fsync
+                # overlaps H+1's propose instead of serializing ahead
+                # of it.
+                mark = wal_mark
+
+                async def _wal_boundary(mark=mark, h=height):
+                    with self.tracer.span(
+                        "wal.pipeline_barrier", height=h
+                    ):
+                        await self.wal.abarrier_to(mark)
+
+                barrier = _wal_boundary
             self.pipeline.begin(
                 height,
                 lambda: self._apply_committed(
                     height, bid, block, base_state, bls_datas
                 ),
+                barrier=barrier,
             )
             self._update_to_state(
                 self._provisional_state(base_state, bid, block),
                 provisional=True,
             )
+            self._maybe_chain_qc(height, seen_commit, base_state)
             self._schedule_round_0()
+            await self._drain_next_height_buf()
             return
 
         state_copy = base_state.copy()
@@ -1261,7 +1417,9 @@ class ConsensusState:
 
         self._update_to_state(new_state)
         self._notify_height(height)
+        self._maybe_chain_qc(height, seen_commit, base_state)
         self._schedule_round_0()
+        await self._drain_next_height_buf()
 
     def _record_committed(
         self, t_commit: float, block, parts, pipelined: bool
@@ -1322,13 +1480,76 @@ class ConsensusState:
             app_hash=state.app_hash,
         )
 
+    def _maybe_chain_qc(self, height: int, seen_commit, base_state) -> None:
+        """Chain `height`'s QC assembly behind its commit: when WE
+        propose the next height, start the aggregate + pairing check in
+        the executor NOW, so by propose time the certificate is (almost
+        always) already sitting in the chain instead of being assembled
+        on the propose critical path. Called after _update_to_state, so
+        self.state.validators is already the NEXT height's set and
+        _is_proposer answers for it; `base_state` still holds the set
+        that signed `seen_commit`."""
+        if (
+            not self.config.pipelined_heights
+            or not self.config.quorum_certificates
+            or seen_commit is None
+            or not self._is_proposer(0)
+            or not base_state.validators.qc_capable()
+        ):
+            return
+        from ..types.quorum_cert import assemble_qc
+
+        loop = asyncio.get_running_loop()
+        chain_id = base_state.chain_id
+        val_set = base_state.validators
+        t0 = time.perf_counter()
+
+        async def _assemble():
+            qc = await loop.run_in_executor(
+                None, assemble_qc, chain_id, seen_commit, val_set
+            )
+            self.tracer.add_span(
+                "commit.qc_assemble",
+                t0,
+                time.perf_counter() - t0,
+                height=height,
+            )
+            return qc
+
+        prev = self._qc_chain
+        if prev is not None and not prev[1].done():
+            prev[1].cancel()
+        self._qc_chain = (height, loop.create_task(_assemble()))
+
+    async def _take_chained_qc(self, height: int):
+        """The QC the commit chain assembled for `height`, or None (not
+        chained / failed / chained for another height) — the caller
+        falls back to on-demand assembly. Awaits an in-flight chain: it
+        started at commit time, so by propose time it is typically
+        already done."""
+        chain, self._qc_chain = self._qc_chain, None
+        if chain is None:
+            return None
+        h, task = chain
+        if h != height:
+            task.cancel()
+            return None
+        try:
+            return await task
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("chained qc assembly failed", err=repr(e))
+            return None
+
     async def _apply_committed(
         self, height: int, bid: BlockID, block, base_state: State, bls_datas
     ) -> State:
         """The background finalization task body: ABCI/L2 apply + state
         save, then swap the provisional state for the applied one BEFORE
         the app-hash future resolves, so every awaiter observes the full
-        state."""
+        state. With pipelined heights the pipeline chains this behind
+        the end-height durability barrier (CommitPipeline.begin)."""
         state_copy = base_state.copy()
         with self.tracer.span("exec.apply_block", height=height):
             new_state = await self.executor.apply_block(
@@ -1404,7 +1625,15 @@ class ConsensusState:
         if self.pacing is not None and state.last_block_height > 0:
             commit_wait = self.pacing.commit_wait()
         rs.start_time_ns = base + int(commit_wait * 1e9)
-        if self.config.skip_timeout_commit and last_precommits is not None:
+        if (
+            self.config.skip_timeout_commit
+            or self.config.pipelined_heights
+        ) and last_precommits is not None:
+            # pipelined heights: the closed quorum is the justification —
+            # enter H+1 NOW. Stragglers past this point miss LastCommit
+            # (they still feed the pacing sketch via the late-straggler
+            # path); the commit stays valid at +2/3, and with the QC
+            # plane on the certificate carries the same quorum compressed.
             rs.start_time_ns = self.now_ns()
         rs.proposal = None
         rs.proposal_block = None
@@ -1629,6 +1858,16 @@ class ConsensusState:
             vals = self.state.last_validators
         elif vote.height == self.rs.height:
             vals = self.state.validators
+        elif (
+            vote.height == self.rs.height + 1
+            and self.config.pipelined_heights
+        ):
+            # pipelined peers run one height ahead while our finalize
+            # drains; their H+1 votes are buffered, but pre-verify them
+            # against the set the state transition already determined
+            # (validators(H+1) = next_validators) so the micro-batcher
+            # amortizes them too
+            vals = self.state.next_validators
         else:
             return None
         if vals is None:
@@ -1792,6 +2031,19 @@ class ConsensusState:
         if self.broadcast_hook is not None:
             self.broadcast_hook(VoteMessage(vote))
         return vote
+
+
+def _msg_height(msg) -> Optional[int]:
+    """The consensus height a queue message belongs to, or None for
+    message kinds without one (the pipelined next-height buffer keys
+    on this)."""
+    if isinstance(msg, ProposalMessage):
+        return msg.proposal.height
+    if isinstance(msg, (BlockPartMessage, VoteBatchMessage)):
+        return msg.height
+    if isinstance(msg, VoteMessage):
+        return msg.vote.height
+    return None
 
 
 # --- WAL codec for consensus messages -------------------------------------

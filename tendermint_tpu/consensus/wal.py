@@ -170,6 +170,18 @@ class WAL:
             None, self._sync_timed
         )
 
+    # pipeline-boundary barrier surface: `mark()` names the set of
+    # records written so far; `abarrier_to(mark)` resolves when an fsync
+    # covers exactly that set — so a background finalization task can
+    # wait for ITS height's end-height record without being extended by
+    # whatever the next height has written since. The plain WAL has no
+    # sequence bookkeeping: one inline fsync covers everything.
+    def mark(self) -> int:
+        return 0
+
+    async def abarrier_to(self, mark: int) -> None:
+        await self.abarrier()
+
     def close(self) -> None:
         self._group.close()
 
@@ -311,9 +323,22 @@ class GroupCommitWAL(WAL):
         written so far is covered by an fsync, without blocking the
         event loop while the disk syncs. Raises if the flush thread
         latched an fsync failure for uncovered records."""
+        await self.abarrier_to(self.mark())
+
+    def mark(self) -> int:
+        """Sequence number naming every record written so far — the
+        pipelined finalize takes one right after its end-height write,
+        so its background barrier covers exactly that boundary and is
+        never extended by the next height's traffic."""
+        with self._mtx:
+            return self._written_seq
+
+    async def abarrier_to(self, mark: int) -> None:
+        """abarrier for an explicit `mark` (see WAL.mark): resolves when
+        an fsync covers every record up to it."""
         loop = asyncio.get_running_loop()
         with self._mtx:
-            target = self._written_seq
+            target = mark
             if self._synced_seq >= target:
                 return
             if self._error is not None:
@@ -467,6 +492,12 @@ class NilWAL:
         pass
 
     async def abarrier(self) -> None:
+        pass
+
+    def mark(self) -> int:
+        return 0
+
+    async def abarrier_to(self, mark: int) -> None:
         pass
 
     def close(self) -> None:

@@ -562,6 +562,9 @@ class Node(Service):
             self.pacing = PacingController.from_config(
                 sm_config, metrics=consensus_metrics, tracer=self.tracer
             )
+            # learned tails live next to the WAL: same durability
+            # domain, wiped by the same data reset
+            self.pacing.persist_path = config.wal_file + ".pacing.json"
             self.logger.info(
                 "adaptive consensus pacing enabled",
                 tail_q=config.consensus.adaptive_tail_quantile,

@@ -88,6 +88,24 @@ class StreamingQuantile:
         self._sorted = None
         self.count = 0
 
+    # --- persistence (pacing-tail warm starts) ----------------------------
+
+    def to_list(self) -> list[float]:
+        """The windowed samples in arrival order — with `count`, the
+        sketch's full restorable state."""
+        return list(self._ring)
+
+    def load(self, samples: Iterable[float], count: int = 0) -> None:
+        """Restore a persisted window (consensus/pacing.py warm start).
+        Replaces the current contents; `count` restores the lifetime
+        tally (defaults to the window length so min_samples gating
+        still sees the restored evidence)."""
+        self._ring.clear()
+        for x in samples:
+            self._ring.append(float(x))
+        self._sorted = None
+        self.count = max(int(count), len(self._ring))
+
     def snapshot(self) -> dict:
         """Summary dict for reports/tests (p50/p95/p99/max/counts)."""
         return {

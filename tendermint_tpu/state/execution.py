@@ -64,8 +64,11 @@ class ABCIResponses:
                     for r in self.deliver_txs
                 ],
                 "val_updates": [
-                    [t, data.hex(), power]
-                    for (t, data, power) in self.val_updates
+                    # 4th column (BLS pubkey) only when carried, so
+                    # pre-QC records decode byte-identically
+                    [u[0], u[1].hex(), u[2]]
+                    + ([u[3].hex()] if len(u) > 3 and u[3] else [])
+                    for u in self.val_updates
                 ],
                 "param_updates": self.param_updates,
             }
@@ -88,8 +91,10 @@ class ABCIResponses:
                 )
             )
         out.val_updates = [
-            (t, bytes.fromhex(h), power)
-            for (t, h, power) in obj.get("val_updates", [])
+            (row[0], bytes.fromhex(row[1]), row[2], bytes.fromhex(row[3]))
+            if len(row) > 3
+            else (row[0], bytes.fromhex(row[1]), row[2])
+            for row in obj.get("val_updates", [])
         ]
         out.param_updates = obj.get("param_updates")
         if out.param_updates is not None:
@@ -238,7 +243,12 @@ class BlockExecutor:
         # else the app's end_block updates (upstream behavior)
         if not val_updates and abci_responses.end_block is not None:
             val_updates = [
-                (u.pub_key_type, u.pub_key_data, u.power)
+                (
+                    u.pub_key_type,
+                    u.pub_key_data,
+                    u.power,
+                    getattr(u, "bls_pub_key", b""),
+                )
                 for u in abci_responses.end_block.validator_updates
             ]
 
@@ -388,9 +398,15 @@ class BlockExecutor:
         next_validators = state.next_validators.copy()
         last_height_vals_changed = state.last_height_validators_changed
         if val_updates:
+            # rows are (type, data, power) or, QC plane, a 4th element:
+            # the BLS pubkey riding the L2/end_block rotation
             changes = [
-                Validator(pubkey_from_type(t, data), power)
-                for (t, data, power) in val_updates
+                Validator(
+                    pubkey_from_type(u[0], u[1]),
+                    u[2],
+                    bls_pub_key=u[3] if len(u) > 3 else b"",
+                )
+                for u in val_updates
             ]
             next_validators.update_with_change_set(changes)
             last_height_vals_changed = block.header.height + 1 + 1

@@ -226,6 +226,11 @@ class ValidatorSet:
                 nv.proposer_priority = -(new_total + (new_total >> 3))
             else:
                 nv.proposer_priority = prev.proposer_priority
+                # a power update with no BLS key keeps the key on
+                # record — otherwise every L2 rotation would silently
+                # strip QC capability from sitting members
+                if not nv.bls_pub_key:
+                    nv.bls_pub_key = prev.bls_pub_key
             updated[a] = nv
 
         self.validators = sorted(updated.values(), key=lambda v: v.address)

@@ -41,6 +41,8 @@ def make_node(
     tracer=None,
     verifier=None,
     health=None,
+    wal=None,
+    commit_pipeline=None,
 ):
     l2 = l2 or MockL2Node()
     app = KVStoreApplication()
@@ -63,6 +65,8 @@ def make_node(
         tracer=tracer,
         verifier=verifier,
         health=health,
+        wal=wal,
+        commit_pipeline=commit_pipeline,
     )
     return cs, app, l2, block_store, state_store
 

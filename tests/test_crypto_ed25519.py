@@ -79,3 +79,44 @@ def test_address():
     pk = ed25519.PrivKey.from_secret(b"v").public_key()
     assert pk.address() == hashlib.sha256(pk.data).digest()[:20]
     assert len(pk.address()) == 20
+
+
+def test_fast_scalar_paths_match_generic_oracle():
+    """The host fast paths (fixed-base comb, 4-bit windowed multiply)
+    must agree with the generic double-and-add `scalar_mult`, which
+    stays untouched as the oracle the device kernels also verify
+    against. Deterministic scalars: edge cases + pseudorandom sweep."""
+    G = ed25519.BASEPOINT
+    scalars = [0, 1, 2, ed25519.L - 1, ed25519.L, ed25519.L + 1]
+    for i in range(24):
+        scalars.append(
+            int.from_bytes(hashlib.sha512(b"k%d" % i).digest(), "little")
+            % (2 * ed25519.L)
+        )
+    A = ed25519.point_decompress(
+        ed25519.PrivKey.from_secret(b"oracle").public_key().data
+    )
+    for k in scalars:
+        want_base = ed25519.point_compress(ed25519.scalar_mult(k, G))
+        got_base = ed25519.point_compress(ed25519.scalar_mult_base(k))
+        assert got_base == want_base, f"scalar_mult_base diverged at {k}"
+        want_var = ed25519.point_compress(ed25519.scalar_mult(k, A))
+        got_var = ed25519.point_compress(ed25519._window_mult(k, A))
+        assert got_var == want_var, f"_window_mult diverged at {k}"
+
+
+def test_cached_seed_expansion_keeps_keys_distinct():
+    """The lru-cached seed expansion / pubkey decompression must never
+    cross-contaminate keys: distinct seeds produce distinct, correctly
+    verifying keypairs even when interleaved (cache hit path)."""
+    keys = [ed25519.PrivKey.from_secret(b"cache%d" % i) for i in range(4)]
+    msgs = [b"payload-%d" % i for i in range(4)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    # interleave verifies to exercise cache hits across keys
+    for _ in range(2):
+        for k, m, s in zip(keys, msgs, sigs):
+            assert ed25519.verify(k.public_key().data, m, s)
+        for k, m, s in zip(keys, msgs, sigs):
+            # wrong key must still fail on the cached decompression
+            other = keys[(keys.index(k) + 1) % len(keys)]
+            assert not ed25519.verify(other.public_key().data, m, s)

@@ -749,3 +749,89 @@ def test_pacing_report_cli_smoke(tmp_path):
     # the floor bucket is the cs.new_height window here: 40 of 62 ms
     agg = rep["wall"]["aggregate"]
     assert agg["floor_share"] == pytest.approx(40.0 / 62.0, abs=0.01)
+
+
+# --- persistence (learned-tail warm starts) ---------------------------------
+
+
+def test_tails_roundtrip_restores_schedule(tmp_path):
+    """save_tails/load_tails: a fresh controller that loads a trained
+    one's file derives the identical schedule — no re-learning heights,
+    no min_samples gating on restart."""
+    path = str(tmp_path / "cs.wal.pacing.json")
+    pc = _controller()
+    for _ in range(16):
+        pc.observe_post_quorum_straggler(VoteType.PRECOMMIT, 0.004)
+        pc.observe_vote_arrival(VoteType.PREVOTE, 0.002)
+        pc.observe_vote_arrival(VoteType.PRECOMMIT, 0.003)
+        pc.observe_proposal_complete(0.005)
+    for h in range(8):
+        pc.on_height_committed(h, 0)  # decay backoff
+    assert pc.commit_wait() < 0.1  # actually learned something
+    assert pc.save_tails(path)
+
+    fresh = _controller()
+    assert fresh.commit_wait() == 0.1  # static before the load
+    assert fresh.load_tails(path)
+    for step in PACING_STEPS:
+        assert fresh._steps[step].snapshot() == pc._steps[step].snapshot()
+    assert fresh.commit_wait() == pc.commit_wait()
+
+
+def test_tails_load_tolerates_missing_and_corrupt(tmp_path):
+    pc = _controller()
+    assert not pc.load_tails(str(tmp_path / "nope.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert not pc.load_tails(str(bad))
+    bad.write_text(json.dumps({"schema": "something-else", "steps": {}}))
+    assert not pc.load_tails(str(bad))
+    # junk inside one step must not poison the controller
+    blob = pc.state_dict()
+    blob["steps"]["commit"]["samples"] = ["zebra"]
+    pc2 = _controller()
+    pc2.load_state(blob)
+    assert pc2.commit_wait() == 0.1  # commit stayed static
+    # unconfigured controller: both directions are clean no-ops
+    assert not pc.save_tails()
+    assert not pc.load_tails()
+
+
+def test_tails_survive_state_machine_restart(tmp_path):
+    """Integration: a ConsensusState with persist_path saves on stop and
+    the next incarnation warm-starts with the learned commit wait."""
+    from tests.helpers import make_genesis, make_validators
+
+    from .test_consensus import make_node
+
+    path = str(tmp_path / "cs.wal.pacing.json")
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    cfg = ConsensusConfig.test_config()
+    cfg.adaptive_timeouts = True
+    cfg.adaptive_min_samples = 2
+
+    async def first():
+        cs, *_ = make_node(vs, pvs[0], genesis, config=cfg)
+        cs.pacing.persist_path = path
+        for _ in range(8):
+            cs.pacing.observe_post_quorum_straggler(
+                VoteType.PRECOMMIT, 0.001
+            )
+        await cs.start()
+        await cs.wait_for_height(2, timeout=30)
+        await cs.stop()
+        return cs.pacing.snapshot()["steps"]["commit"]["samples"]
+
+    samples = asyncio.run(first())
+    assert samples >= 8
+
+    async def second():
+        cs, *_ = make_node(vs, pvs[0], genesis, config=cfg)
+        cs.pacing.persist_path = path
+        await cs.start()
+        restored = cs.pacing.snapshot()["steps"]["commit"]["samples"]
+        await cs.stop()
+        return restored
+
+    assert asyncio.run(second()) >= samples

@@ -1,0 +1,530 @@
+"""QC-chained height pipelining (PERF_ANALYSIS §22): provisional entry
+into H+1 on H's precommit quorum close, with H's apply/save/fsync chained
+behind the WAL durability barrier in the background.
+
+Covers the pieces the serial suites can't: the next-height holding
+buffer (peers running one height ahead), overlap-aware wall conservation
+on a live pipelined net, chained-QC justification on the wire, crash
+recovery across the pipelined boundary (H+1's proposal signed, H's
+decision not yet durable — the double-sign window), and a legacy
+non-pipelined peer following a pipelined chain over real p2p.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+from io import BytesIO
+
+import pytest
+
+from tendermint_tpu import obs
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.consensus.commit_pipeline import CommitPipeline
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state_machine import (
+    ConsensusConfig,
+    ConsensusState,
+)
+from tendermint_tpu.consensus.messages import VoteMessage
+from tendermint_tpu.consensus.wal import (
+    GroupCommitWAL,
+    KIND_END_HEIGHT,
+    decode_records,
+    encode_record,
+)
+from tendermint_tpu.crypto import bls_signatures as bls
+from tendermint_tpu.crypto.bls12_381 import R
+from tendermint_tpu.l2node.mock import MockL2Node
+from tendermint_tpu.libs import protoio as pio
+from tendermint_tpu.privval.file_pv import FilePV, STEP_PROPOSE
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import State
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.block_store import WriteBehindBlockStore
+from tendermint_tpu.store.kv import MemKV
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote, VoteType
+
+from .helpers import (
+    CHAIN_ID,
+    make_genesis,
+    make_qc_validators,
+    make_validators,
+)
+from .test_consensus import make_node, wire_net
+
+pytestmark = pytest.mark.pipeline
+
+
+def _pipelined_config(**overrides) -> ConsensusConfig:
+    cfg = ConsensusConfig.test_config()
+    cfg.pipelined_heights = True
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+# --- construction -----------------------------------------------------------
+
+
+def test_pipelined_config_self_constructs_pipeline():
+    """pipelined_heights with no injected CommitPipeline must still get
+    one: without it the 'pipelined' finalize silently degrades to the
+    serial path (the background-overlap half of the feature vanishes)."""
+    vs, pvs = make_validators(1)
+    genesis = make_genesis(vs)
+    cs, *_ = make_node(vs, pvs[0], genesis, config=_pipelined_config())
+    assert cs.pipeline is not None
+    # and the flag off means no self-construction
+    cs2, *_ = make_node(vs, pvs[0], genesis)
+    assert cs2.pipeline is None
+
+
+# --- next-height holding buffer ---------------------------------------------
+
+
+def _vote_msg(height: int) -> VoteMessage:
+    return VoteMessage(
+        Vote(
+            type=VoteType.PREVOTE,
+            height=height,
+            round=0,
+            block_id=BlockID(b"\x00" * 32, PartSetHeader()),
+            timestamp_ns=0,
+            validator_address=b"\x00" * 20,
+            validator_index=0,
+        )
+    )
+
+
+def test_next_height_buffer_holds_caps_and_drains():
+    """H+1 traffic is held (not dropped) while this node still closes H,
+    the buffer is hard-capped against future-height floods, and the
+    drain discards stale (already-decided) entries while re-feeding
+    current-height ones."""
+    vs, pvs = make_validators(2)
+    genesis = make_genesis(vs)
+    cs, *_ = make_node(vs, pvs[0], genesis, config=_pipelined_config())
+
+    async def run():
+        cs.rs.height = 5
+        # H+1 is held before any verification (the signature is junk)
+        await cs._handle_msg(_vote_msg(6), "peer")
+        assert len(cs._next_height_buf) == 1
+        # hard cap: a byzantine flood must not grow memory
+        cs._NEXT_HEIGHT_BUF_CAP = 3
+        for _ in range(5):
+            await cs._handle_msg(_vote_msg(6), "peer")
+        assert len(cs._next_height_buf) == 3
+        # a stale entry (height already decided by the time we drain)
+        cs._buffer_next_height_msg(_vote_msg(2), "peer")
+        cs.rs.height = 6
+        await cs._drain_next_height_buf()
+        # everything re-fed or discarded; nothing wedged in the buffer
+        assert cs._next_height_buf == []
+
+    asyncio.run(run())
+
+
+def test_next_height_buffer_refuses_messages_still_ahead():
+    """Draining below the buffered height re-stashes instead of feeding
+    messages the state machine would reject."""
+    vs, pvs = make_validators(2)
+    genesis = make_genesis(vs)
+    cs, *_ = make_node(vs, pvs[0], genesis, config=_pipelined_config())
+
+    async def run():
+        cs.rs.height = 5
+        await cs._handle_msg(_vote_msg(6), "peer")
+        assert len(cs._next_height_buf) == 1
+        await cs._drain_next_height_buf()  # still at 5: nothing to feed
+        assert len(cs._next_height_buf) == 1
+
+    asyncio.run(run())
+
+
+# --- live pipelined net: equivalence + overlap conservation -----------------
+
+
+def _run_net(pipelined: bool, heights: int, tracer=None, n: int = 4):
+    """4-validator in-proc net; returns ([cs], app_hash set at `heights`)."""
+    vs, pvs = make_validators(n)
+    genesis = make_genesis(vs)
+    cfg = _pipelined_config() if pipelined else ConsensusConfig.test_config()
+
+    async def run():
+        nodes = [
+            make_node(
+                vs,
+                pv,
+                genesis,
+                config=cfg,
+                tracer=(tracer if i == 0 else None),
+            )
+            for i, pv in enumerate(pvs)
+        ]
+        css = [nd[0] for nd in nodes]
+        wire_net(css)
+        for cs in css:
+            await cs.start()
+        await asyncio.gather(
+            *(cs.wait_for_height(heights, timeout=90) for cs in css)
+        )
+        for cs in css:
+            await cs.stop()
+        return css
+
+    css = asyncio.run(run())
+    blocks = {cs.block_store.load_block(heights).hash() for cs in css}
+    assert len(blocks) == 1, "pipelined net diverged"
+    return css
+
+
+def test_pipelined_net_matches_serial_app_hash():
+    """The pipelined and serial nets must commit identical chains: same
+    per-height app hash, every node in agreement."""
+    H = 4
+    piped = _run_net(True, H)
+    serial = _run_net(False, H)
+    for cs in piped:
+        assert cs.pipeline is not None
+    ph = piped[0].block_store.load_block(H).header.app_hash
+    sh = serial[0].block_store.load_block(H).header.app_hash
+    assert ph == sh, "pipelined chain diverged from serial"
+
+
+def test_pipelined_net_conserves_wall_with_overlap_credit():
+    """Overlap-aware conservation on a live pipelined net: every
+    completed height's buckets sum to wall + booked pipeline_overlap_ms
+    (never silently exceeding the wall), and the validator passes."""
+    tracer = obs.Tracer(enabled=True, ring_size=65536)
+    _run_net(True, 5, tracer=tracer)
+    recs = [r.to_json() for r in tracer.records()]
+    cons = obs.wall_conservation(recs)
+    rows = cons.get("heights", {})
+    assert rows, "no conservation rows from the pipelined run"
+    assert obs.check_conservation(cons) == []
+    assert cons["aggregate"]["conserved"] is True
+    for h, row in rows.items():
+        assert "pipeline_overlap_ms" in row
+        assert row["pipeline_overlap_ms"] >= 0.0
+
+
+# --- chained QC justification -----------------------------------------------
+
+
+def test_pipelined_chain_carries_chained_qc():
+    """With the QC plane on, a pipelined 4-validator chain ships every
+    block's justification: last_qc assembled from the previous height's
+    precommit quorum (chained behind the commit on the proposer), and it
+    verifies against the committed validator set."""
+    vs, pvs, privs = make_qc_validators(4, seed=b"pipeqc")
+    genesis = make_genesis(vs)
+    cfg = _pipelined_config(quorum_certificates=True)
+    H = 4
+
+    async def run():
+        nodes = []
+        for pv in pvs:
+            addr = pv.get_pub_key().address()
+            cs, app, l2, bs, ss = make_node(
+                vs,
+                pv,
+                genesis,
+                config=cfg,
+                bls_signer=bls.signer_for(privs[addr]),
+            )
+            cs.executor.qc_enabled = True
+            nodes.append(cs)
+        wire_net(nodes)
+        for cs in nodes:
+            await cs.start()
+        await asyncio.gather(
+            *(cs.wait_for_height(H, timeout=90) for cs in nodes)
+        )
+        for cs in nodes:
+            await cs.stop()
+        return nodes
+
+    nodes = asyncio.run(run())
+    hashes = {cs.block_store.load_block(H).hash() for cs in nodes}
+    assert len(hashes) == 1
+    bs = nodes[0].block_store
+    for h in range(2, H):
+        blk = bs.load_block(h + 1)
+        assert blk.last_qc is not None, f"height {h + 1} shipped without qc"
+        assert blk.last_qc.height == h
+        vs.verify_commit_qc(CHAIN_ID, blk.last_qc.block_id, h, blk.last_qc)
+
+
+# --- crash across the pipelined boundary ------------------------------------
+
+
+class _RecordingPV:
+    """FilePV wrapper recording every signature it hands out, keyed by
+    (height, round, step) — the double-sign ledger both incarnations of
+    the crash test share. `freeze_at=H` refuses any signing past
+    (H, 0, propose): it pins the privval at the exact crash instant the
+    test wants (H's proposal signed, nothing later)."""
+
+    def __init__(self, inner: FilePV, book: dict, freeze_at=None):
+        self.inner = inner
+        self.book = book
+        self.freeze_at = freeze_at
+
+    def get_pub_key(self):
+        return self.inner.get_pub_key()
+
+    def sign_proposal(self, chain_id, proposal):
+        if self.freeze_at is not None and (
+            proposal.height > self.freeze_at
+            or (proposal.height == self.freeze_at and proposal.round > 0)
+        ):
+            raise RuntimeError("crash window: signing frozen")
+        self.inner.sign_proposal(chain_id, proposal)
+        self.book.setdefault(
+            (proposal.height, proposal.round, "proposal"), set()
+        ).add(bytes(proposal.signature))
+
+    def sign_vote(self, chain_id, vote):
+        if self.freeze_at is not None and vote.height >= self.freeze_at:
+            raise RuntimeError("crash window: signing frozen")
+        self.inner.sign_vote(chain_id, vote)
+        self.book.setdefault(
+            (vote.height, vote.round, int(vote.type)), set()
+        ).add(bytes(vote.signature))
+
+
+def _crash_node(genesis, pv, wal_path, block_kv, state_kv, bls_scalar):
+    """Pipelined + QC single-validator node over explicit restartable
+    stores and a real on-disk group-commit WAL."""
+    app = KVStoreApplication()
+    l2 = MockL2Node()
+    state_store = StateStore(state_kv)
+    block_store = WriteBehindBlockStore(block_kv, max_inflight=4)
+    wal = GroupCommitWAL(wal_path, flush_interval=0.001)
+    state = state_store.load()
+    if state is None:
+        state = State.from_genesis(genesis)
+        state_store.bootstrap(state)
+    executor = BlockExecutor(state_store, block_store, LocalClient(app), l2)
+    executor.qc_enabled = True
+    cfg = _pipelined_config(quorum_certificates=True)
+    cs = ConsensusState(
+        cfg,
+        state,
+        executor,
+        block_store,
+        l2,
+        priv_validator=pv,
+        wal=wal,
+        commit_pipeline=CommitPipeline(),
+        bls_signer=bls.signer_for(bls_scalar),
+    )
+    return cs, block_store, state_store
+
+
+def _truncate_wal_after_end_height(path: str, h: int) -> None:
+    """Cut the WAL file to the prefix ending at end_height(h) — the
+    durable image of a crash whose later records never got fsynced
+    (group commit loses a suffix, never the middle)."""
+    data = open(path, "rb").read()
+    off = 0
+    cut = None
+    for m in decode_records(data, lenient=True):
+        off += len(encode_record(m))
+        if (
+            m.kind == KIND_END_HEIGHT
+            and pio.read_uvarint(BytesIO(m.data)) == h
+        ):
+            cut = off
+            break
+    assert cut is not None, f"no end_height({h}) record in the WAL"
+    with open(path, "r+b") as f:
+        f.truncate(cut)
+
+
+@pytest.mark.chaos
+def test_crash_between_next_propose_and_durable_boundary(tmp_path):
+    """THE pipelined-boundary crash window: the node signed H+1's
+    proposal (privval state advanced — that write is synchronous and
+    survives) while H's decision is not yet in the stores and the H+1
+    records never reached disk. Restart must replay H from the WAL,
+    re-enter H+1, and continue WITHOUT double-signing (the privval
+    refuses the conflicting re-proposal; the round advances instead)
+    and WITHOUT skipping a height — and the chained-QC justification
+    re-derives across the boundary."""
+    CRASH_H = 4
+    kp, sp = str(tmp_path / "pv_key.json"), str(tmp_path / "pv_state.json")
+    wal_path = str(tmp_path / "wal")
+    fpv = FilePV.generate(kp, sp)
+    scalar = (
+        int.from_bytes(hashlib.sha256(b"crash-bls").digest(), "big")
+        % (R - 1)
+        + 1
+    )
+    pub = bls.pubkey_from_priv(scalar)
+    vs = ValidatorSet(
+        [
+            Validator(
+                fpv.get_pub_key(), 10, bls_pub_key=bls.g2_to_bytes(pub.key)
+            )
+        ]
+    )
+    genesis = make_genesis(vs)
+    book: dict = {}
+    block_kv, state_kv = MemKV(), MemKV()
+
+    async def first_run():
+        pv = _RecordingPV(FilePV.load(kp, sp), book, freeze_at=CRASH_H)
+        cs, bs, ss = _crash_node(
+            genesis, pv, wal_path, block_kv, state_kv, scalar
+        )
+        hs = Handshaker(ss, bs, genesis, cs.executor)
+        cs.state = await hs.handshake(cs.state)
+        await cs.start()
+        await cs.wait_for_height(2, timeout=60)
+        bs.wait_durable()
+        # the durable crash image of the STORES: everything the
+        # write-behind worker and the background apply had persisted by
+        # now — later saves are the writes the crash loses
+        snap_block = {k: v for k, v in block_kv.iterate()}
+        snap_state = {k: v for k, v in state_kv.iterate()}
+        deadline = time.monotonic() + 60
+        while (CRASH_H, 0, "proposal") not in book:
+            assert time.monotonic() < deadline, "H+1 proposal never signed"
+            await asyncio.sleep(0.005)
+        await cs.stop()
+        bs.stop()
+        cs.wal.close()
+        return snap_block, snap_state
+
+    snap_block, snap_state = asyncio.run(first_run())
+    # the privval froze at exactly (CRASH_H, 0, propose) — the window
+    pv_check = FilePV.load(kp, sp)
+    assert pv_check.last_state.height == CRASH_H
+    assert pv_check.last_state.step == STEP_PROPOSE
+    # crash image: WAL durable through end_height(H-1) only (the H+1
+    # proposal record and anything later lost with the unsynced suffix)
+    _truncate_wal_after_end_height(wal_path, CRASH_H - 1)
+
+    async def second_run():
+        block_kv2, state_kv2 = MemKV(), MemKV()
+        for k, v in snap_block.items():
+            block_kv2.set(k, v)
+        for k, v in snap_state.items():
+            state_kv2.set(k, v)
+        pv = _RecordingPV(FilePV.load(kp, sp), book)
+        cs, bs, ss = _crash_node(
+            genesis, pv, wal_path, block_kv2, state_kv2, scalar
+        )
+        hs = Handshaker(ss, bs, genesis, cs.executor)
+        cs.state = await hs.handshake(cs.state)
+        await cs.start()  # WAL catchup replays H-1's tail, re-drives H
+        await cs.wait_for_height(CRASH_H + 2, timeout=90)
+        await cs.stop()
+        bs.stop()
+        cs.wal.close()
+        return cs, bs
+
+    cs, bs = asyncio.run(second_run())
+    # no height skip: the chain is contiguous through the boundary
+    assert cs.state.last_block_height >= CRASH_H + 2
+    for h in range(2, CRASH_H + 3):
+        blk = bs.load_block(h)
+        prev = bs.load_block(h - 1)
+        assert blk is not None, f"height {h} missing after replay"
+        assert blk.header.last_block_id.hash == prev.hash(), (
+            f"chain broken at {h}"
+        )
+    # no double-sign: every (height, round, step) ever signed got
+    # exactly ONE signature across both incarnations
+    for key, sigs in book.items():
+        assert len(sigs) == 1, f"double sign at {key}: {len(sigs)} sigs"
+    assert (CRASH_H, 0, "proposal") in book
+    # the conflicting re-proposal was REFUSED, so the boundary height
+    # committed at a later round (liveness via round advance, not
+    # equivocation)
+    assert bs.load_seen_commit(CRASH_H).round >= 1
+    # chained-QC justification re-derived across the boundary
+    blk = bs.load_block(CRASH_H + 1)
+    assert blk.last_qc is not None
+    assert blk.last_qc.height == CRASH_H
+    vs.verify_commit_qc(CHAIN_ID, blk.last_qc.block_id, CRASH_H, blk.last_qc)
+
+
+# --- legacy interop ---------------------------------------------------------
+
+
+def test_legacy_peer_follows_pipelined_chain():
+    """A non-pipelined peer in a majority-pipelined committee must keep
+    up over real p2p: pipelined peers run one height ahead while the
+    legacy node still finalizes serially, so it leans on the reactor's
+    catchup gossip (stored block parts + reconstructed commit votes)
+    for anything it missed live."""
+    from .test_consensus_reactor import build_p2p_node, connect_full_mesh
+
+    vs, pvs = make_validators(4)
+    genesis = make_genesis(vs)
+    H = 3
+
+    async def run():
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = (
+                _pipelined_config()
+                if i < 3
+                else ConsensusConfig.test_config()
+            )
+            nodes.append(build_p2p_node(vs, pv, genesis, config=cfg))
+        for cs, nk, t, sw in nodes:
+            await t.listen()
+            await sw.start()
+        await connect_full_mesh(nodes)
+        for cs, *_ in nodes:
+            await cs.start()
+        await asyncio.gather(
+            *(cs.wait_for_height(H, timeout=90) for cs, *_ in nodes)
+        )
+        hashes = {cs.block_store.load_block(H).hash() for cs, *_ in nodes}
+        legacy = nodes[3][0]
+        assert legacy.pipeline is None
+        assert not legacy.config.pipelined_heights
+        for cs, nk, t, sw in nodes:
+            await cs.stop()
+            await sw.stop()
+        return hashes
+
+    hashes = asyncio.run(run())
+    assert len(hashes) == 1, "legacy peer diverged from the pipelined chain"
+
+
+# --- soak -------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_pipelined_soak_conserves_and_overlaps():
+    """Longer pipelined run: 12 heights on the 4-validator net with
+    tracing on — every completed height stays conserved under overlap
+    accounting, the net never diverges, and the run actually books
+    background overlap (the feature is exercised, not just enabled)."""
+    tracer = obs.Tracer(enabled=True, ring_size=65536)
+    css = _run_net(True, 12, tracer=tracer)
+    recs = [r.to_json() for r in tracer.records()]
+    cons = obs.wall_conservation(recs)
+    rows = cons.get("heights", {})
+    assert len(rows) >= 8
+    assert obs.check_conservation(cons) == []
+    agg = cons.get("aggregate", {})
+    assert agg.get("dark_fraction", 1.0) <= 0.05
+    assert (
+        sum(r.get("pipeline_overlap_ms", 0.0) for r in rows.values()) > 0.0
+    )
+    for cs in css:
+        assert cs.state.last_block_height >= 12

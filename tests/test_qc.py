@@ -844,3 +844,80 @@ def test_legacy_chain_syncs_on_qc_consumer():
     )
     assert consumer.blocks_applied == heights - 2
     assert consumer.qc_verified_blocks == 0
+
+
+def test_l2_rotation_carries_bls_key_into_next_qc_bitset():
+    """Satellite regression (PERF_ANALYSIS §22): a BLS pubkey riding an
+    L2 validator update (state/execution 4-column val_updates) reaches
+    the stored set, flips it QC-capable, and the rotated-keyed member
+    lands in the next quorum certificate's signer bitset."""
+    from tendermint_tpu.consensus.state_machine import ConsensusConfig
+    from tendermint_tpu.l2node.mock import MockL2Node
+
+    from .test_consensus import make_node, wire_net
+
+    vs, pvs, privs = make_qc_validators(4, seed=b"rotate")
+    # strip one member's BLS key from genesis: the set starts NOT
+    # qc_capable, so no height can carry a QC until the rotation lands
+    bare = vs.validators[2]
+    key_backfill = bare.bls_pub_key
+    bare.bls_pub_key = b""
+    genesis = make_genesis(vs)
+    rotate_h, last_h = 3, 9
+    # the update applied at rotate_h becomes next_validators(rotate_h+1)
+    # = validators(rotate_h+2): first QC-capable height
+    capable_h = rotate_h + 2
+
+    async def run():
+        nodes = []
+        cfg = ConsensusConfig.test_config()
+        cfg.quorum_certificates = True
+        for pv in pvs:
+            l2 = MockL2Node()
+            # every replica delivers the same rotation: the sitting
+            # member's ed25519 identity + unchanged power, now with its
+            # BLS key in the 4th column
+            l2.validator_updates[rotate_h] = [
+                ("ed25519", bare.pub_key.data, bare.voting_power,
+                 key_backfill)
+            ]
+            addr = pv.get_pub_key().address()
+            cs, app, _, bs, ss = make_node(
+                vs, pv, genesis, l2=l2, config=cfg,
+                bls_signer=bls.signer_for(privs[addr]),
+            )
+            cs.executor.qc_enabled = True
+            nodes.append((cs, bs, ss))
+        css = [n[0] for n in nodes]
+        wire_net(css)
+        for cs in css:
+            await cs.start()
+        await asyncio.gather(
+            *(cs.wait_for_height(last_h, timeout=60) for cs in css)
+        )
+        for cs in css:
+            await cs.stop()
+        return nodes[0][1], nodes[0][2]
+
+    bs, ss = asyncio.run(run())
+    rot_idx = 2
+    # pre-rotation heights can never carry a QC (set not capable)
+    for h in range(2, capable_h):
+        blk = bs.load_block(h + 1)
+        assert blk.last_qc is None, f"height {h} got a QC pre-rotation"
+    # post-rotation: some height in [capable_h, last_h) carries one
+    # (round-0 proposer assembly is best-effort, so scan the window)
+    carried = [
+        bs.load_block(h + 1).last_qc
+        for h in range(capable_h, last_h - 1)
+        if bs.load_block(h + 1) and bs.load_block(h + 1).last_qc
+    ]
+    assert carried, "no QC produced after the rotation landed"
+    qc = carried[0]
+    set_at = ss.load_validators(qc.height)
+    assert set_at is not None and set_at.qc_capable()
+    assert set_at.validators[rot_idx].bls_pub_key == key_backfill
+    assert qc.signers.get(rot_idx), (
+        "rotated-keyed validator missing from the QC bitset"
+    )
+    set_at.verify_commit_qc(CHAIN_ID, qc.block_id, qc.height, qc)

@@ -71,6 +71,7 @@ from tendermint_tpu.obs.report import check_conservation  # noqa: E402
 _FAMILY_PREFIXES = (
     ("verify_service", "verify_service"),
     ("scheduler_", "scheduler"),
+    ("consensus_pipeline", "consensus_pipeline"),
     ("consensus_pacing", "consensus_pacing"),
     ("consensus_", "consensus"),
     ("lightserve", "lightserve"),
@@ -100,6 +101,11 @@ _FAMILY_PREFIXES = (
 TIER1_FAMILIES = frozenset(
     {
         "crypto",
+        # QC-chained height pipelining (PERF_ANALYSIS §22): headline is
+        # effective wall-per-height with overlapped consecutive heights;
+        # its conservation block books buckets > wall only by the
+        # explicit pipeline_overlap_ms credit (obs.check_conservation)
+        "consensus_pipeline",
         "consensus_pacing",
         "consensus",
         "lightserve",
